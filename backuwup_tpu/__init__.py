@@ -1,0 +1,35 @@
+"""backuwup_tpu — a TPU-native peer-to-peer encrypted backup framework.
+
+A brand-new framework with the capabilities of the Rust reference
+``profi248/backuwup`` (a P2P encrypted backup system: clients trade disk space
+with matched peers, a coordination server does identity / matchmaking /
+rendezvous only, and all backup data flows client<->client, end-to-end
+encrypted), re-designed TPU-first:
+
+* the content-defined chunker (windowed Gear rolling hash, FastCDC-2020-style
+  normalized chunking) and the BLAKE3 chunk-fingerprint stage run as batched
+  ``jit(vmap(...))`` JAX/Pallas kernels scanning many streams in parallel
+  (reference hot loop: ``client/src/backup/filesystem/dir_packer.rs:246-311``);
+* the global dedup index is a sharded open-addressed hash-table probe over TPU
+  HBM under ``shard_map`` (reference: in-memory sorted vec + binary search,
+  ``client/src/backup/filesystem/packfile/blob_index.rs:143-148``);
+* long streams are split block-wise across devices with a 31-byte Gear-hash
+  halo exchanged over ICI — the sequence-parallel decomposition of this domain.
+
+Layer map (mirrors SURVEY.md section 1):
+
+=====  =============================  ==================================
+layer  reference                       backuwup_tpu
+=====  =============================  ==================================
+L0     ``shared/src``                  :mod:`backuwup_tpu.wire`, :mod:`backuwup_tpu.defaults`
+L1     ``client/src/key_manager.rs``   :mod:`backuwup_tpu.crypto`
+L2     ``client/src/config``           :mod:`backuwup_tpu.store.config_db`
+L3     ``client/src/backup``           :mod:`backuwup_tpu.ops`, :mod:`backuwup_tpu.models`,
+                                       :mod:`backuwup_tpu.store`, :mod:`backuwup_tpu.engine`
+L4     ``client/src/net_*``            :mod:`backuwup_tpu.net`
+L5     ``client/src/ui``               :mod:`backuwup_tpu.ui`
+L6     ``server/src``                  :mod:`backuwup_tpu.net.server`
+=====  =============================  ==================================
+"""
+
+__version__ = "0.1.0"

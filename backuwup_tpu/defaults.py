@@ -1,0 +1,73 @@
+"""Compile-time tunables for the whole framework.
+
+Mirrors the constant surface of the reference (``client/src/defaults.rs:1-68``,
+``shared/src/constants.rs:4-7``, ``client/src/backup/filesystem/packfile/mod.rs:25-31``,
+``shared/src/p2p_message.rs:8``, ``client/src/backup/filesystem/dir_packer.rs:35``,
+``client/src/backup/filesystem/packfile/blob_index.rs:16``), plus the
+TPU-kernel tunables that have no reference equivalent.
+"""
+
+KiB = 1024
+MiB = 1024 * KiB
+GiB = 1024 * MiB
+
+# --- content-defined chunking (reference client/src/defaults.rs:62-68) ------
+CDC_MIN_CHUNK = 256 * KiB
+CDC_DESIRED_CHUNK = 1 * MiB
+CDC_MAX_CHUNK = 3 * MiB
+
+# Normalized-chunking mask widths (FastCDC 2020, normalization level 2):
+# below the desired size a stricter mask applies, above it a looser one.
+CDC_MASK_S_BITS = 22  # desired 2**20 => 20 + 2
+CDC_MASK_L_BITS = 18  # 20 - 2
+
+# --- packfiles (reference packfile/mod.rs:25-31) -----------------------------
+PACKFILE_TARGET_SIZE = 3 * MiB
+PACKFILE_MAX_SIZE = 16 * MiB
+PACKFILE_MAX_BLOBS = 100_000
+ZSTD_COMPRESSION_LEVEL = 3
+
+# --- blob index (reference blob_index.rs:16) --------------------------------
+INDEX_FILE_MAX_ENTRIES = 50_000
+
+# --- tree blobs (reference dir_packer.rs:35) --------------------------------
+TREE_MAX_CHILDREN = 10_000
+
+# --- send pipeline / backpressure (reference defaults.rs:38-59) -------------
+PACKFILE_LOCAL_BUFFER_LIMIT = 100 * MiB
+PACKFILE_RESUME_THRESHOLD = 50 * MiB  # resume packing when this much is free
+PACKFILE_SEND_TIMEOUT_S = 20.0
+ACK_TIMEOUT_S = 5.0
+STORAGE_REQUEST_RETRY_S = 10.0
+RESTORE_REQUEST_THROTTLE_S = 60.0
+STORAGE_REQUEST_STEP = 50 * 1000 * 1000  # 50 MB (decimal, like the reference)
+STORAGE_REQUEST_CAP = 150 * 1000 * 1000  # 150 MB
+PEER_OVERUSE_GRACE = 16 * MiB  # tolerated overshoot per peer (defaults.rs:34)
+
+# --- protocol limits (reference shared/src/constants.rs:4-7) ----------------
+MAX_BACKUP_STORAGE_REQUEST_SIZE = 16 * GiB
+BACKUP_REQUEST_EXPIRY_S = 300.0
+
+# --- p2p transport (reference shared/src/p2p_message.rs:8) ------------------
+MAX_P2P_MESSAGE_SIZE = 8 * MiB
+
+# --- server-side TTLs (reference server/src/client_auth_manager.rs:17-20) ---
+AUTH_CHALLENGE_TTL_S = 30.0
+SESSION_TTL_S = 24 * 3600.0
+P2P_REQUEST_TTL_S = 60.0
+
+# --- UI cadence (reference ws_status_message.rs:134-141, backup/mod.rs:112) -
+PROGRESS_DEBOUNCE_S = 0.1
+PEERS_DEBOUNCE_S = 0.25
+PROGRESS_TICKER_S = 0.4
+
+# --- TPU execution tunables (no reference equivalent) -----------------------
+# Device block length for the gear-hash scan: streams are cut into blocks of
+# this many bytes, sharded across devices with a GEAR_WINDOW-1 byte halo.
+TPU_STREAM_BLOCK = 4 * MiB
+# Leaf bucket sizes (in 1 KiB blake3 chunks) used when batching variable-size
+# CDC chunks for fingerprinting; chunks are padded up to the nearest bucket.
+BLAKE3_LEAF_BUCKETS = (16, 64, 256, 1024, 2048, 3072)
+# Sharded dedup index: default capacity per device shard (slots) and probe cap.
+DEDUP_SHARD_CAPACITY = 1 << 20
+DEDUP_MAX_PROBES = 32

@@ -1,0 +1,458 @@
+"""L0 wire types: IDs, nonces, hashes, blob/tree model, protocol messages.
+
+Re-designs the reference ``shared/`` crate (``shared/src/types.rs:4-37``,
+``shared/src/client_message.rs``, ``shared/src/server_message.rs``,
+``shared/src/server_message_ws.rs``, ``shared/src/p2p_message.rs``) and the
+client blob model (``client/src/backup/filesystem/mod.rs:14-105``) as plain
+dataclasses plus a deterministic binary codec (:mod:`backuwup_tpu.utils.serialization`).
+
+Control-plane messages travel as JSON (``to_json``/``from_json``); data-plane
+blobs/trees/p2p bodies travel in the binary codec, mirroring the reference's
+serde_json-vs-bincode split (SURVEY.md section 2.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Optional
+
+from .utils.serialization import Reader, Writer
+
+# --- fixed-size value types (reference shared/src/types.rs:4-37) ------------
+CLIENT_ID_LEN = 32  # Ed25519 public key doubles as the client identity
+BLOB_HASH_LEN = 32  # blake3 digest
+PACKFILE_ID_LEN = 12  # doubles as the packfile header AES-GCM nonce
+SESSION_TOKEN_LEN = 16
+TRANSPORT_NONCE_LEN = 16
+CHALLENGE_NONCE_LEN = 32
+
+
+def _check(name: str, value: bytes, length: int) -> bytes:
+    if not isinstance(value, (bytes, bytearray)) or len(value) != length:
+        raise ValueError(f"{name} must be exactly {length} bytes, got {value!r:.60}")
+    return bytes(value)
+
+
+class BlobKind(IntEnum):
+    """reference client/src/backup/filesystem/mod.rs:14-18."""
+
+    FILE_CHUNK = 0
+    TREE = 1
+
+
+class CompressionKind(IntEnum):
+    """reference client/src/backup/filesystem/mod.rs:20-24 (Zstd added Zlib
+    fallback for hosts without libzstd)."""
+
+    NONE = 0
+    ZSTD = 1
+    ZLIB = 2
+
+
+class TreeKind(IntEnum):
+    FILE = 0
+    DIR = 1
+
+
+@dataclass(frozen=True)
+class Blob:
+    """An unencrypted unit of backup data (mod.rs:37-43)."""
+
+    hash: bytes
+    kind: BlobKind
+    data: bytes
+
+    def __post_init__(self) -> None:
+        _check("blob hash", self.hash, BLOB_HASH_LEN)
+
+
+@dataclass(frozen=True)
+class PackfileHeaderBlob:
+    """Per-blob entry of a packfile header (mod.rs:26-35)."""
+
+    hash: bytes
+    kind: BlobKind
+    compression: CompressionKind
+    length: int  # encrypted (nonce + ciphertext) byte length
+    offset: int  # offset into the blob section
+
+    def encode(self, w: Writer) -> None:
+        w.fixed(_check("packfile blob hash", self.hash, BLOB_HASH_LEN))
+        w.u32(int(self.kind))
+        w.u32(int(self.compression))
+        w.u64(self.length)
+        w.u64(self.offset)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "PackfileHeaderBlob":
+        return cls(
+            hash=r.fixed(BLOB_HASH_LEN),
+            kind=BlobKind(r.u32()),
+            compression=CompressionKind(r.u32()),
+            length=r.u64(),
+            offset=r.u64(),
+        )
+
+
+@dataclass(frozen=True)
+class TreeMetadata:
+    """reference mod.rs:76-81."""
+
+    size: int = 0
+    mtime_ns: int = 0
+    ctime_ns: int = 0
+
+    def encode(self, w: Writer) -> None:
+        w.u64(self.size)
+        w.u64(self.mtime_ns)
+        w.u64(self.ctime_ns)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "TreeMetadata":
+        return cls(size=r.u64(), mtime_ns=r.u64(), ctime_ns=r.u64())
+
+
+@dataclass
+class Tree:
+    """A directory or file node blob (reference mod.rs:62-74).
+
+    ``children`` of a DIR tree are hashes of child Tree blobs; ``children`` of
+    a FILE tree are hashes of its FILE_CHUNK blobs in order.  A node with more
+    than TREE_MAX_CHILDREN children is split, the continuation linked through
+    ``next_sibling`` (reference dir_packer.rs:313-363).
+    """
+
+    kind: TreeKind
+    name: str
+    metadata: TreeMetadata
+    children: list = field(default_factory=list)
+    next_sibling: Optional[bytes] = None
+
+    def encode_bytes(self) -> bytes:
+        w = Writer()
+        w.u32(int(self.kind))
+        w.str(self.name)
+        self.metadata.encode(w)
+        w.u64(len(self.children))
+        for c in self.children:
+            w.fixed(_check("tree child hash", c, BLOB_HASH_LEN))
+        w.opt_fixed(self.next_sibling, BLOB_HASH_LEN)
+        return w.take()
+
+    @classmethod
+    def decode_bytes(cls, buf: bytes) -> "Tree":
+        r = Reader(buf)
+        kind = TreeKind(r.u32())
+        name = r.str()
+        metadata = TreeMetadata.decode(r)
+        children = [r.fixed(BLOB_HASH_LEN) for _ in range(r.u64())]
+        next_sibling = r.opt_fixed(BLOB_HASH_LEN)
+        r.expect_end()
+        return cls(kind=kind, name=name, metadata=metadata, children=children,
+                   next_sibling=next_sibling)
+
+
+# --- control-plane JSON messages (reference shared/src/client_message.rs,
+#     server_message.rs, server_message_ws.rs) -------------------------------
+
+
+def _hex(b: Optional[bytes]) -> Optional[str]:
+    return None if b is None else bytes(b).hex()
+
+
+def _unhex(s: Optional[str], length: Optional[int], name: str) -> Optional[bytes]:
+    if s is None:
+        return None
+    b = bytes.fromhex(s)
+    return b if length is None else _check(name, b, length)
+
+
+class JsonMessage:
+    """Tagged-JSON base: ``{"t": <class name>, ...fields}``.
+
+    Byte fields are declared via ``_bytes_fields = {name: length}`` (length
+    ``None`` = variable) and hex-encoded on the wire.
+    """
+
+    _bytes_fields: dict = {}
+    _registry: dict = {}
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        JsonMessage._registry[cls.__name__] = cls
+
+    def to_json(self) -> str:
+        out = {"t": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if f.name in self._bytes_fields:
+                v = _hex(v)
+            out[f.name] = v
+        return json.dumps(out, separators=(",", ":"), sort_keys=True)
+
+    @staticmethod
+    def from_json(s: str) -> "JsonMessage":
+        obj = json.loads(s)
+        tag = obj.pop("t", None)
+        cls = JsonMessage._registry.get(tag)
+        if cls is None:
+            raise ValueError(f"unknown message tag {tag!r}")
+        kw = {}
+        for f in dataclasses.fields(cls):
+            v = obj.get(f.name)
+            if v is None:
+                # Fields without a dataclass default are required: reject
+                # missing/null so untrusted input can't construct half-built
+                # protocol messages.
+                required = (f.default is dataclasses.MISSING
+                            and f.default_factory is dataclasses.MISSING)
+                if required:
+                    raise ValueError(f"{tag}: missing required field {f.name!r}")
+                continue
+            if f.name in cls._bytes_fields:
+                if not isinstance(v, str):
+                    raise ValueError(f"{tag}: field {f.name!r} must be a hex string")
+                v = _unhex(v, cls._bytes_fields[f.name], f.name)
+            kw[f.name] = v
+        return cls(**kw)
+
+
+# client -> server (reference shared/src/client_message.rs:9-77)
+
+@dataclass
+class ClientRegistrationRequest(JsonMessage):
+    pubkey: bytes
+    _bytes_fields = {"pubkey": CLIENT_ID_LEN}
+
+
+@dataclass
+class ClientRegistrationAuth(JsonMessage):
+    pubkey: bytes
+    challenge_response: bytes  # signature over the challenge nonce
+    _bytes_fields = {"pubkey": CLIENT_ID_LEN, "challenge_response": None}
+
+
+@dataclass
+class ClientLoginRequest(JsonMessage):
+    pubkey: bytes
+    _bytes_fields = {"pubkey": CLIENT_ID_LEN}
+
+
+@dataclass
+class ClientLoginAuth(JsonMessage):
+    pubkey: bytes
+    challenge_response: bytes
+    _bytes_fields = {"pubkey": CLIENT_ID_LEN, "challenge_response": None}
+
+
+@dataclass
+class BackupRequest(JsonMessage):
+    session_token: bytes
+    storage_required: int
+    _bytes_fields = {"session_token": SESSION_TOKEN_LEN}
+
+
+@dataclass
+class BeginP2PConnectionRequest(JsonMessage):
+    session_token: bytes
+    destination_client_id: bytes
+    session_nonce: bytes
+    _bytes_fields = {
+        "session_token": SESSION_TOKEN_LEN,
+        "destination_client_id": CLIENT_ID_LEN,
+        "session_nonce": TRANSPORT_NONCE_LEN,
+    }
+
+
+@dataclass
+class ConfirmP2PConnectionRequest(JsonMessage):
+    session_token: bytes
+    source_client_id: bytes
+    destination_ip_address: str
+    _bytes_fields = {"session_token": SESSION_TOKEN_LEN,
+                     "source_client_id": CLIENT_ID_LEN}
+
+
+@dataclass
+class BackupRestoreRequest(JsonMessage):
+    session_token: bytes
+    _bytes_fields = {"session_token": SESSION_TOKEN_LEN}
+
+
+@dataclass
+class BackupDone(JsonMessage):
+    session_token: bytes
+    snapshot_hash: bytes
+    _bytes_fields = {"session_token": SESSION_TOKEN_LEN,
+                     "snapshot_hash": BLOB_HASH_LEN}
+
+
+# server -> client HTTP responses (reference shared/src/server_message.rs:9-54)
+
+@dataclass
+class Ok(JsonMessage):
+    pass
+
+
+@dataclass
+class ServerChallenge(JsonMessage):
+    nonce: bytes
+    _bytes_fields = {"nonce": CHALLENGE_NONCE_LEN}
+
+
+@dataclass
+class LoginToken(JsonMessage):
+    token: bytes
+    _bytes_fields = {"token": SESSION_TOKEN_LEN}
+
+
+@dataclass
+class BackupRestoreInfo(JsonMessage):
+    snapshot_hash: Optional[bytes] = None
+    peers: list = field(default_factory=list)  # hex client ids
+    _bytes_fields = {"snapshot_hash": BLOB_HASH_LEN}
+
+
+@dataclass
+class Error(JsonMessage):
+    # reference ErrorType has 8 variants (server_message.rs:22-40); carried as
+    # a string kind plus human-readable detail.
+    kind: str = "Failure"
+    detail: str = ""
+
+
+# server -> client WS push (reference shared/src/server_message_ws.rs:9-35)
+
+@dataclass
+class Ping(JsonMessage):
+    pass
+
+
+@dataclass
+class BackupMatched(JsonMessage):
+    destination_id: bytes
+    storage_available: int
+    _bytes_fields = {"destination_id": CLIENT_ID_LEN}
+
+
+@dataclass
+class IncomingP2PConnection(JsonMessage):
+    source_client_id: bytes
+    session_nonce: bytes
+    _bytes_fields = {"source_client_id": CLIENT_ID_LEN,
+                     "session_nonce": TRANSPORT_NONCE_LEN}
+
+
+@dataclass
+class FinalizeP2PConnection(JsonMessage):
+    destination_client_id: bytes
+    destination_ip_address: str
+    _bytes_fields = {"destination_client_id": CLIENT_ID_LEN}
+
+
+# --- p2p data-plane messages (reference shared/src/p2p_message.rs) ----------
+
+class RequestType(IntEnum):
+    """p2p_message.rs:36-39."""
+
+    TRANSPORT = 0
+    RESTORE_ALL = 1
+
+
+class FileInfoKind(IntEnum):
+    """p2p_message.rs:51-54."""
+
+    PACKFILE = 0
+    INDEX = 1
+
+
+@dataclass(frozen=True)
+class P2PHeader:
+    """Replay-protection header (p2p_message.rs:21-24)."""
+
+    sequence_number: int
+    session_nonce: bytes
+
+    def encode(self, w: Writer) -> None:
+        w.u64(self.sequence_number)
+        w.fixed(_check("session nonce", self.session_nonce, TRANSPORT_NONCE_LEN))
+
+    @classmethod
+    def decode(cls, r: Reader) -> "P2PHeader":
+        return cls(sequence_number=r.u64(), session_nonce=r.fixed(TRANSPORT_NONCE_LEN))
+
+
+class P2PBodyKind(IntEnum):
+    REQUEST = 0
+    FILE = 1
+    ACK = 2
+
+
+@dataclass(frozen=True)
+class P2PBody:
+    """Union of the three signed p2p body kinds (p2p_message.rs:27-61):
+    connection-init request (seq 0), file payload, ack."""
+
+    kind: P2PBodyKind
+    header: P2PHeader
+    request_type: Optional[RequestType] = None  # REQUEST
+    file_info: Optional[FileInfoKind] = None  # FILE
+    file_id: bytes = b""  # FILE: packfile id or index number (LE bytes)
+    data: bytes = b""  # FILE payload
+    acked_sequence: int = 0  # ACK
+
+    def encode_bytes(self) -> bytes:
+        w = Writer()
+        w.u32(int(self.kind))
+        self.header.encode(w)
+        if self.kind == P2PBodyKind.REQUEST:
+            w.u32(int(self.request_type))
+        elif self.kind == P2PBodyKind.FILE:
+            w.u32(int(self.file_info))
+            w.blob(self.file_id)
+            w.blob(self.data)
+        elif self.kind == P2PBodyKind.ACK:
+            w.u64(self.acked_sequence)
+        return w.take()
+
+    @classmethod
+    def decode_bytes(cls, buf: bytes) -> "P2PBody":
+        r = Reader(buf)
+        kind = P2PBodyKind(r.u32())
+        header = P2PHeader.decode(r)
+        kw = {}
+        if kind == P2PBodyKind.REQUEST:
+            kw["request_type"] = RequestType(r.u32())
+        elif kind == P2PBodyKind.FILE:
+            kw["file_info"] = FileInfoKind(r.u32())
+            kw["file_id"] = r.blob()
+            kw["data"] = r.blob()
+        elif kind == P2PBodyKind.ACK:
+            kw["acked_sequence"] = r.u64()
+        r.expect_end()
+        return cls(kind=kind, header=header, **kw)
+
+
+@dataclass(frozen=True)
+class EncapsulatedMsg:
+    """Signed envelope for every p2p message (p2p_message.rs:12-17)."""
+
+    body: bytes  # encoded P2PBody
+    signature: bytes  # Ed25519 signature over body
+
+    def encode_bytes(self) -> bytes:
+        w = Writer()
+        w.blob(self.body)
+        w.blob(self.signature)
+        return w.take()
+
+    @classmethod
+    def decode_bytes(cls, buf: bytes) -> "EncapsulatedMsg":
+        r = Reader(buf)
+        body = r.blob()
+        sig = r.blob()
+        r.expect_end()
+        return cls(body=body, signature=sig)

@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX imports.
+
+Multi-chip hardware is not available in CI; sharding correctness is validated
+on host-platform virtual devices (SURVEY.md section 7 / the driver's
+``dryrun_multichip`` contract).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import random
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return random.Random(1234)
+
+
+@pytest.fixture
+def nprng():
+    return np.random.default_rng(1234)

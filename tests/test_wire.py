@@ -1,0 +1,114 @@
+"""L0 codec and message round-trip tests (golden-file style per SURVEY.md §4)."""
+
+import pytest
+
+from backuwup_tpu import wire
+from backuwup_tpu.utils.serialization import CodecError, Reader, Writer
+
+
+def test_writer_reader_roundtrip():
+    w = Writer()
+    w.u8(7)
+    w.u32(0xDEADBEEF)
+    w.u64(1 << 45)
+    w.blob(b"hello")
+    w.str("päth/ü")
+    w.opt_fixed(None, 32)
+    w.opt_fixed(b"\x01" * 32, 32)
+    buf = w.take()
+
+    r = Reader(buf)
+    assert r.u8() == 7
+    assert r.u32() == 0xDEADBEEF
+    assert r.u64() == 1 << 45
+    assert r.blob() == b"hello"
+    assert r.str() == "päth/ü"
+    assert r.opt_fixed(32) is None
+    assert r.opt_fixed(32) == b"\x01" * 32
+    r.expect_end()
+
+
+def test_reader_truncation_raises():
+    r = Reader(b"\x01\x02")
+    with pytest.raises(CodecError):
+        r.u64()
+
+
+def test_tree_roundtrip_deterministic():
+    t = wire.Tree(
+        kind=wire.TreeKind.DIR,
+        name="subdir",
+        metadata=wire.TreeMetadata(size=123, mtime_ns=10**18, ctime_ns=42),
+        children=[bytes([i] * 32) for i in range(3)],
+        next_sibling=b"\xaa" * 32,
+    )
+    enc1 = t.encode_bytes()
+    enc2 = wire.Tree.decode_bytes(enc1).encode_bytes()
+    assert enc1 == enc2
+    back = wire.Tree.decode_bytes(enc1)
+    assert back.kind == wire.TreeKind.DIR
+    assert back.name == "subdir"
+    assert back.children == t.children
+    assert back.next_sibling == t.next_sibling
+
+
+def test_json_messages_roundtrip():
+    msgs = [
+        wire.ClientRegistrationRequest(pubkey=b"\x01" * 32),
+        wire.BackupRequest(session_token=b"\x02" * 16, storage_required=10**9),
+        wire.ServerChallenge(nonce=b"\x03" * 32),
+        wire.BackupMatched(destination_id=b"\x04" * 32, storage_available=5),
+        wire.Error(kind="NoData", detail="nothing yet"),
+        wire.BackupRestoreInfo(snapshot_hash=None, peers=["ab" * 32]),
+    ]
+    for m in msgs:
+        s = m.to_json()
+        back = wire.JsonMessage.from_json(s)
+        assert back == m, s
+
+
+def test_json_unknown_tag_rejected():
+    with pytest.raises(ValueError):
+        wire.JsonMessage.from_json('{"t":"Nope"}')
+
+
+def test_json_missing_required_field_rejected():
+    with pytest.raises(ValueError, match="missing required field"):
+        wire.JsonMessage.from_json('{"t":"BackupRequest"}')
+    with pytest.raises(ValueError, match="missing required field"):
+        wire.JsonMessage.from_json('{"t":"ClientRegistrationRequest","pubkey":null}')
+    # optional fields may be absent
+    m = wire.JsonMessage.from_json('{"t":"BackupRestoreInfo"}')
+    assert m == wire.BackupRestoreInfo(snapshot_hash=None, peers=[])
+
+
+def test_json_non_string_bytes_field_rejected():
+    with pytest.raises(ValueError, match="hex string"):
+        wire.JsonMessage.from_json('{"t":"ClientRegistrationRequest","pubkey":123}')
+
+
+def test_packfile_header_blob_bad_hash_rejected():
+    from backuwup_tpu.utils.serialization import Writer
+    bad = wire.PackfileHeaderBlob(hash=b"short", kind=wire.BlobKind.FILE_CHUNK,
+                                  compression=wire.CompressionKind.NONE,
+                                  length=1, offset=0)
+    with pytest.raises(ValueError):
+        bad.encode(Writer())
+
+
+def test_p2p_body_roundtrip():
+    hdr = wire.P2PHeader(sequence_number=7, session_nonce=b"\x09" * 16)
+    bodies = [
+        wire.P2PBody(kind=wire.P2PBodyKind.REQUEST, header=hdr,
+                     request_type=wire.RequestType.RESTORE_ALL),
+        wire.P2PBody(kind=wire.P2PBodyKind.FILE, header=hdr,
+                     file_info=wire.FileInfoKind.PACKFILE,
+                     file_id=b"\x01" * 12, data=b"x" * 1000),
+        wire.P2PBody(kind=wire.P2PBodyKind.ACK, header=hdr, acked_sequence=6),
+    ]
+    for b in bodies:
+        enc = b.encode_bytes()
+        assert wire.P2PBody.decode_bytes(enc) == b
+
+    env = wire.EncapsulatedMsg(body=bodies[1].encode_bytes(), signature=b"s" * 64)
+    assert wire.EncapsulatedMsg.decode_bytes(env.encode_bytes()) == env
