@@ -1,0 +1,7 @@
+"""Compute kernels: content-defined chunking + BLAKE3 fingerprinting.
+
+CPU oracle implementations (:mod:`.blake3_cpu`, :mod:`.cdc_cpu`) define the
+bit-exact semantics; TPU implementations (:mod:`.blake3_tpu`, :mod:`.cdc_tpu`)
+must match them exactly — dedup-ratio parity is the judged metric
+(BASELINE.md).  The backend seam is :mod:`.backend`.
+"""
