@@ -1,0 +1,75 @@
+"""Deterministic GEAR table + CDC parameter set (see CDC_SPEC.md)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import defaults
+
+_M64 = (1 << 64) - 1
+GEAR_SEED = 0x6261636B75777570  # "backuwup"
+GEAR_WINDOW = 32  # bytes of influence of the 32-bit rolling hash
+
+
+def _splitmix64_stream(seed: int, count: int):
+    out = []
+    state = seed
+    for _ in range(count):
+        state = (state + 0x9E3779B97F4A7C15) & _M64
+        z = state
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _M64
+        z = z ^ (z >> 31)
+        out.append(z)
+    return out
+
+
+def make_gear_table() -> np.ndarray:
+    """256 x uint32, high halves of SplitMix64(GEAR_SEED) outputs."""
+    return np.array([z >> 32 for z in _splitmix64_stream(GEAR_SEED, 256)],
+                    dtype=np.uint32)
+
+
+GEAR = make_gear_table()
+
+
+def _top_bits_mask(bits: int) -> int:
+    if not 0 < bits < 32:
+        raise ValueError("mask bits must be in (0, 32)")
+    return (0xFFFFFFFF << (32 - bits)) & 0xFFFFFFFF
+
+
+@dataclass(frozen=True)
+class CDCParams:
+    """Chunking parameters; defaults mirror client/src/defaults.rs:62-68."""
+
+    min_size: int = defaults.CDC_MIN_CHUNK
+    desired_size: int = defaults.CDC_DESIRED_CHUNK
+    max_size: int = defaults.CDC_MAX_CHUNK
+    mask_s_bits: int = defaults.CDC_MASK_S_BITS
+    mask_l_bits: int = defaults.CDC_MASK_L_BITS
+
+    def __post_init__(self) -> None:
+        if not (0 < self.min_size <= self.desired_size <= self.max_size):
+            raise ValueError("require 0 < min <= desired <= max")
+        if self.mask_l_bits >= self.mask_s_bits:
+            raise ValueError("mask_l must be looser (fewer bits) than mask_s")
+
+    @property
+    def mask_s(self) -> int:
+        return _top_bits_mask(self.mask_s_bits)
+
+    @property
+    def mask_l(self) -> int:
+        return _top_bits_mask(self.mask_l_bits)
+
+    @classmethod
+    def from_desired(cls, desired: int) -> "CDCParams":
+        if desired & (desired - 1):
+            raise ValueError("desired size must be a power of two")
+        bits = desired.bit_length() - 1
+        return cls(min_size=max(64, desired // 4), desired_size=desired,
+                   max_size=3 * desired, mask_s_bits=bits + 2,
+                   mask_l_bits=bits - 2)

@@ -1,0 +1,97 @@
+"""Windowed Gear CDC: scalar-oracle vs vectorized parity, spec edge cases."""
+
+import numpy as np
+import pytest
+
+from backuwup_tpu.ops.cdc_cpu import (candidate_positions, chunk_stream,
+                                      chunk_stream_scalar, gear_hashes,
+                                      gear_hashes_scalar, select_cuts)
+from backuwup_tpu.ops.gear import GEAR, GEAR_WINDOW, CDCParams
+
+SMALL = CDCParams.from_desired(1024)  # min 256 / desired 1024 / max 3072
+
+
+def test_gear_table_properties():
+    assert GEAR.shape == (256,) and GEAR.dtype == np.uint32
+    assert len(set(GEAR.tolist())) == 256  # no collisions in the table
+    # regression pin: table is deterministic data, not environment-dependent
+    assert GEAR[0] == np.uint32(0x131937B3), hex(int(GEAR[0]))
+    assert GEAR[1] == np.uint32(0x9E5463A0), hex(int(GEAR[1]))
+
+
+def test_gear_hash_scalar_vs_vectorized(nprng):
+    data = nprng.integers(0, 256, size=5000, dtype=np.uint8).tobytes()
+    np.testing.assert_array_equal(gear_hashes_scalar(data), gear_hashes(data))
+
+
+def test_gear_hash_halo_equivalence(nprng):
+    data = nprng.integers(0, 256, size=4096, dtype=np.uint8).tobytes()
+    full = gear_hashes(data)
+    for split in (0, 1, 17, 31, 32, 33, 1000, 4095, 4096):
+        left, right = data[:split], data[split:]
+        got = np.concatenate([gear_hashes(left),
+                              gear_hashes(right, prev_tail=left)])
+        np.testing.assert_array_equal(full, got, err_msg=f"split={split}")
+
+
+def test_chunk_scalar_vs_vectorized(nprng):
+    for size in (0, 1, 255, 256, 257, 1024, 3072, 3073, 50_000, 200_000):
+        data = nprng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+        assert chunk_stream(data, SMALL) == chunk_stream_scalar(data, SMALL), size
+
+
+def test_chunks_partition_stream(nprng):
+    data = nprng.integers(0, 256, size=300_000, dtype=np.uint8).tobytes()
+    chunks = chunk_stream(data, SMALL)
+    assert sum(c[1] for c in chunks) == len(data)
+    pos = 0
+    for off, ln in chunks:
+        assert off == pos
+        assert 1 <= ln <= SMALL.max_size
+        pos = off + ln
+    # all but the final chunk respect the minimum
+    assert all(ln >= SMALL.min_size for _, ln in chunks[:-1])
+
+
+def test_low_entropy_forces_max_cuts():
+    data = b"\x00" * 10_000
+    chunks = chunk_stream(data, SMALL)
+    # constant input yields no candidates -> forced cuts at max, runt at EOF
+    assert [ln for _, ln in chunks] == [3072, 3072, 3072, 784]
+
+
+def test_insertion_resync(nprng):
+    """Window-local hashing re-synchronizes after an insertion."""
+    data = nprng.integers(0, 256, size=400_000, dtype=np.uint8).tobytes()
+    mutated = data[:200_000] + b"INSERTED" + data[200_000:]
+    a = {data[o:o + l] for o, l in chunk_stream(data, SMALL)}
+    b = {mutated[o:o + l] for o, l in chunk_stream(mutated, SMALL)}
+    # chunks strictly before the edit and well after it must be shared
+    assert len(a & b) >= len(a) // 2
+
+
+def test_select_cuts_eof_runt():
+    params = SMALL
+    # no candidates at all: pure min/max geometry
+    ends = select_cuts(np.empty(0, np.int64), np.empty(0, np.int64),
+                       7000, params)
+    assert ends.tolist() == [3071, 6143, 6999]
+    # empty stream
+    assert select_cuts(np.empty(0, np.int64), np.empty(0, np.int64),
+                       0, params).tolist() == []
+
+
+def test_candidate_subset_property(nprng):
+    data = nprng.integers(0, 256, size=100_000, dtype=np.uint8).tobytes()
+    pos_s, pos_l = candidate_positions(data, SMALL)
+    assert set(pos_s.tolist()) <= set(pos_l.tolist())
+
+
+def test_params_validation():
+    with pytest.raises(ValueError):
+        CDCParams(min_size=10, desired_size=5, max_size=20)
+    with pytest.raises(ValueError):
+        CDCParams.from_desired(1000)  # not a power of two
+    p = CDCParams.from_desired(8192)
+    assert (p.min_size, p.desired_size, p.max_size) == (2048, 8192, 24576)
+    assert p.mask_s_bits == 15 and p.mask_l_bits == 11
