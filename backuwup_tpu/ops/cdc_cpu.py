@@ -95,17 +95,20 @@ def select_cuts(pos_s: np.ndarray, pos_l: np.ndarray, n: int,
     return np.array(cuts, dtype=np.int64)
 
 
-def chunk_stream(data, params: CDCParams = CDCParams()):
-    """Chunk one stream; returns list of (offset, length)."""
-    n = len(data)
-    pos_s, pos_l = candidate_positions(data, params)
-    ends = select_cuts(pos_s, pos_l, n, params)
-    out = []
-    s = 0
+def cuts_to_chunks(ends) -> list:
+    """Inclusive end positions -> [(offset, length), ...]."""
+    out, s = [], 0
     for e in ends:
         out.append((s, int(e) - s + 1))
         s = int(e) + 1
     return out
+
+
+def chunk_stream(data, params: CDCParams = CDCParams()):
+    """Chunk one stream; returns list of (offset, length)."""
+    n = len(data)
+    pos_s, pos_l = candidate_positions(data, params)
+    return cuts_to_chunks(select_cuts(pos_s, pos_l, n, params))
 
 
 def chunk_stream_scalar(data, params: CDCParams = CDCParams()):

@@ -1,0 +1,348 @@
+"""TPU execution backend for the windowed Gear CDC scan.
+
+Replaces the reference's sequential FastCDC hot loop
+(``client/src/backup/filesystem/dir_packer.rs:246-266``) with a data-parallel
+decomposition designed for XLA/TPU:
+
+* The per-position rolling hash ``h[i] = ((h[i-1] << 1) + GEAR[b[i]]) mod 2^32``
+  is *exactly* equal to the 32-tap windowed sum
+  ``h[i] = sum_{k=0}^{31} GEAR[b[i-k]] << k`` because shifts >= 32 vanish
+  mod 2^32.  The window form has no sequential dependence, so the whole
+  stream is hashed with 32 shifted vector adds — VPU work XLA fuses into a
+  single pass over the bytes.
+* The 256-entry gear-table lookup is executed on the **MXU**, not as a
+  gather (TPU gathers serialize): bytes become a one-hot bf16 matrix that is
+  multiplied against the table split into four 8-bit limbs.  0/1 and 0..255
+  are exact in bf16 and the MXU accumulates in f32, so the product is the
+  exact integer table value.
+* Candidate cut-points (``h & mask == 0``) leave the device as a two-level
+  sparse structure: bits are packed 32:1 into u32 words on the VPU, then a
+  fixed-capacity ``jnp.nonzero`` compacts the (overwhelmingly zero) words,
+  so only a few KiB cross host<->HBM per segment.
+* Final cut selection (min/desired/max + two-mask normalization) runs on the
+  host over the sparse candidates — the same code path as the CPU oracle
+  (:func:`backuwup_tpu.ops.cdc_cpu.select_cuts`), so TPU and CPU chunking
+  are bit-identical by construction.
+* Long streams are processed in bounded segments with a 31-byte carried halo
+  (sequence-parallel blockwise decomposition); across a device mesh the halo
+  travels over ICI via ``ppermute`` (:func:`make_sharded_scanner`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .. import defaults
+from .cdc_cpu import cuts_to_chunks, select_cuts
+from .cdc_cpu import gear_hashes as gear_hashes_np
+from .gear import GEAR, GEAR_WINDOW, CDCParams
+
+_HALO = GEAR_WINDOW - 1  # 31 bytes of left context carry the full hash state
+
+# Gear table split into four 8-bit limbs, (256, 4) — bf16-exact operand.
+_GEAR_LIMBS = np.stack(
+    [(GEAR >> (8 * j)) & 0xFF for j in range(4)], axis=1).astype(np.float32)
+
+
+def _gear_values(b: jnp.ndarray) -> jnp.ndarray:
+    """GEAR[b] for a u8 vector, computed on the MXU via one-hot matmul."""
+    oh = jax.nn.one_hot(b.astype(jnp.int32), 256, dtype=jnp.bfloat16)
+    limbs = jnp.dot(oh, jnp.asarray(_GEAR_LIMBS, dtype=jnp.bfloat16),
+                    preferred_element_type=jnp.float32)
+    l = limbs.astype(jnp.uint32)
+    return (l[:, 0] | (l[:, 1] << jnp.uint32(8))
+            | (l[:, 2] << jnp.uint32(16)) | (l[:, 3] << jnp.uint32(24)))
+
+
+def _hash_ext(ext: jnp.ndarray, halo_len: jnp.ndarray) -> jnp.ndarray:
+    """Per-position hashes for ``ext[_HALO:]``, warmup-exact.
+
+    ``ext`` is ``(_HALO + L,)`` uint8 — 31 bytes of left context followed by
+    the segment.  ``halo_len`` (traced scalar, 0.._HALO) says how many of the
+    context bytes really precede the stream position; taps reaching before
+    the stream start are masked out, reproducing the oracle's short-window
+    warmup at positions < 31.  Unrolled — use only on small/debug inputs
+    (XLA materializes the 32 slice temporaries).
+    """
+    g = _gear_values(ext)
+    L = ext.shape[0] - _HALO
+    j = jnp.arange(L, dtype=jnp.int32)
+    h = jnp.zeros(L, dtype=jnp.uint32)
+    for k in range(GEAR_WINDOW):
+        seg = g[_HALO - k:_HALO - k + L]
+        if k > 0:
+            seg = jnp.where(j >= jnp.int32(k) - halo_len.astype(jnp.int32),
+                            seg, jnp.uint32(0))
+        h = h + (seg << jnp.uint32(k))
+    return h
+
+
+def _hash_ext_fast(ext: jnp.ndarray) -> jnp.ndarray:
+    """Per-position hashes for ``ext[_HALO:]``, production path.
+
+    ``lax.fori_loop`` over the 32 taps keeps peak memory at ~3 stream-sized
+    u32 buffers regardless of segment length.  No warmup masking: the caller
+    zero-fills the halo at a stream start, which perturbs only h[0..30] —
+    positions that can never be selected as cuts because every cut-selection
+    window starts at >= min_size - 1 > 31 (CDC_SPEC.md; min_size >= 64).
+    Candidate *sets* may therefore contain sub-min positions the CPU oracle
+    lacks, but selected cuts are bit-identical.
+    """
+    g = _gear_values(ext)
+    L = ext.shape[0] - _HALO
+
+    def body(k, h):
+        seg = jax.lax.dynamic_slice(g, (_HALO - k,), (L,))
+        return h + (seg << k.astype(jnp.uint32))
+
+    # k=0 term seeds the carry (also gives it the right vma under shard_map)
+    return jax.lax.fori_loop(1, GEAR_WINDOW, body, g[_HALO:])
+
+
+def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """(L,) bool -> (L/32,) u32, little-endian bit order within each word."""
+    w = bits.reshape(-1, 32).astype(jnp.uint32)
+    return jnp.sum(w << jnp.arange(32, dtype=jnp.uint32)[None, :], axis=1,
+                   dtype=jnp.uint32)
+
+
+def _candidate_words(h, n_valid, mask_s, mask_l):
+    """Packed candidate-bit words for both masks (loose ``l``, strict ``s``)."""
+    L = h.shape[0]
+    valid = jnp.arange(L, dtype=jnp.int32) < n_valid
+    cand_l = ((h & mask_l) == 0) & valid
+    cand_s = cand_l & ((h & mask_s) == 0)
+    return _pack_bits(cand_l), _pack_bits(cand_s)
+
+
+@functools.partial(jax.jit, static_argnames=("k_cap",))
+def _scan_segment(ext, n_valid, mask_s, mask_l, *, k_cap: int):
+    """Hash one padded segment, return sparse candidate words.
+
+    Output: ``(widx, wl, ws, nz_words)`` — up to ``k_cap`` indices of nonzero
+    candidate words (-1 padded), the loose/strict packed bits of each, and
+    the true nonzero-word count for overflow detection.
+    """
+    h = _hash_ext_fast(ext)
+    words_l, words_s = _candidate_words(h, n_valid, mask_s, mask_l)
+    nz = words_l != 0
+    (widx,) = jnp.nonzero(nz, size=k_cap, fill_value=-1)
+    nz_words = jnp.sum(nz.astype(jnp.int32))
+    safe = jnp.clip(widx, 0, words_l.shape[0] - 1)
+    return widx, words_l[safe], words_s[safe], nz_words
+
+
+def _decode_words(widx, wl, ws, count, base_offset: int):
+    """Sparse candidate words -> absolute (pos_l, is_s) numpy arrays."""
+    widx = np.asarray(widx)[:count]
+    wl = np.asarray(wl)[:count]
+    ws = np.asarray(ws)[:count]
+    keep = widx >= 0
+    widx, wl, ws = widx[keep], wl[keep], ws[keep]
+    if widx.size == 0:
+        return (np.empty(0, dtype=np.int64), np.empty(0, dtype=bool))
+    bits = np.arange(32, dtype=np.uint32)
+    has_l = ((wl[:, None] >> bits[None, :]) & 1).astype(bool)
+    has_s = ((ws[:, None] >> bits[None, :]) & 1).astype(bool)
+    pos = (widx[:, None].astype(np.int64) * 32 + bits[None, :].astype(np.int64)
+           + base_offset)
+    return pos[has_l], has_s[has_l]
+
+
+def gear_hashes_tpu(data, prev_tail: bytes = b"") -> np.ndarray:
+    """Full per-position hash array on device; mirrors
+    :func:`backuwup_tpu.ops.cdc_cpu.gear_hashes` (test/debug API)."""
+    tail = bytes(prev_tail)[-_HALO:] if prev_tail else b""
+    arr = np.frombuffer(bytes(data), dtype=np.uint8)
+    ext = np.zeros(_HALO + len(arr), dtype=np.uint8)
+    if tail:
+        ext[_HALO - len(tail):_HALO] = np.frombuffer(tail, dtype=np.uint8)
+    ext[_HALO:] = arr
+    out = jax.jit(_hash_ext)(jnp.asarray(ext), jnp.int32(len(tail)))
+    return np.asarray(out)
+
+
+def _round_up(n: int, align: int) -> int:
+    return -(-n // align) * align
+
+
+def _segment_bucket(n: int) -> int:
+    """Padded segment length: power-of-two bucket, >= 64 KiB, so a handful of
+    compiled shapes cover every input size."""
+    b = 64 * 1024
+    while b < n:
+        b *= 2
+    return b
+
+
+class TpuCdcScanner:
+    """Stateless driver: chunk byte streams with the device doing the scan.
+
+    Overflow of the sparse-word capacity (adversarial data only; real data
+    yields ~1 candidate per 2^mask_l_bits bytes) falls back to the numpy
+    oracle for the affected segment, preserving bit-identical output.
+    """
+
+    def __init__(self, params: Optional[CDCParams] = None,
+                 segment_size: int = 128 * defaults.MiB,
+                 cap_factor: int = 16):
+        self.params = params or CDCParams()
+        if self.params.min_size < GEAR_WINDOW:
+            # _hash_ext_fast's zero-filled stream-start halo perturbs
+            # h[0..30]; harmless only when no cut window reaches below 31.
+            raise ValueError(
+                f"TPU chunker requires min_size >= {GEAR_WINDOW}")
+        self.segment_size = segment_size
+        self.cap_factor = cap_factor
+
+    def _k_cap(self, padded: int) -> int:
+        expected = max(1, padded >> self.params.mask_l_bits)
+        return max(512, _round_up(self.cap_factor * expected, 512))
+
+    def candidate_positions(self, data, prev_tail: bytes = b""):
+        """Sorted absolute (pos_s, pos_l) candidate arrays for ``data``."""
+        params = self.params
+        data = bytes(data)
+        n = len(data)
+        all_pos, all_s = [], []
+        offset = 0
+        tail = bytes(prev_tail)[-_HALO:] if prev_tail else b""
+        while offset < n:
+            seg = data[offset:offset + self.segment_size]
+            padded = _segment_bucket(len(seg))
+            ext = np.zeros(_HALO + padded, dtype=np.uint8)
+            if tail:
+                ext[_HALO - len(tail):_HALO] = np.frombuffer(tail, np.uint8)
+            ext[_HALO:_HALO + len(seg)] = np.frombuffer(seg, np.uint8)
+            k_cap = self._k_cap(padded)
+            widx, wl, ws, nz_words = _scan_segment(
+                jnp.asarray(ext), jnp.int32(len(seg)),
+                jnp.uint32(params.mask_s), jnp.uint32(params.mask_l),
+                k_cap=k_cap)
+            if int(nz_words) > k_cap:  # capacity overflow: oracle rescan
+                h = gear_hashes_np(seg, tail)
+                cand_l = (h & np.uint32(params.mask_l)) == 0
+                p = np.nonzero(cand_l)[0].astype(np.int64)
+                s = (h[p] & np.uint32(params.mask_s)) == 0
+                all_pos.append(p + offset)
+                all_s.append(s)
+            else:
+                p, s = _decode_words(widx, wl, ws, k_cap, offset)
+                all_pos.append(p)
+                all_s.append(s)
+            tail = seg[-_HALO:] if len(seg) >= _HALO else (tail + seg)[-_HALO:]
+            offset += len(seg)
+        if all_pos:
+            pos_l = np.concatenate(all_pos)
+            is_s = np.concatenate(all_s)
+        else:
+            pos_l = np.empty(0, dtype=np.int64)
+            is_s = np.empty(0, dtype=bool)
+        return pos_l[is_s], pos_l
+
+    def chunk_stream(self, data):
+        """Chunk one stream; list of (offset, length). Bit-identical to
+        :func:`backuwup_tpu.ops.cdc_cpu.chunk_stream`."""
+        n = len(data)
+        pos_s, pos_l = self.candidate_positions(data)
+        return cuts_to_chunks(select_cuts(pos_s, pos_l, n, self.params))
+
+
+# ---------------------------------------------------------------------------
+# Sharded long-stream scan: blockwise over a device mesh, halo over ICI.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def make_sharded_scanner(mesh: Mesh, axis: str = "data", *,
+                         k_cap_per_shard: int = 4096):
+    """Build a jitted scanner that shards one long stream across ``mesh``.
+
+    The stream (length divisible by the mesh axis size) is split into
+    per-device shards; each device hashes its shard using the 31-byte tail of
+    its left neighbour, exchanged over ICI with ``lax.ppermute`` — the CDC
+    analog of ring-attention's block decomposition (SURVEY.md section 5.7).
+
+    Returns ``scan(stream_u8, n_valid, mask_s, mask_l) ->
+    (widx, wl, ws, nz_words)`` with a leading per-device axis; ``widx`` are
+    *absolute* word indices into the stream (-1 pad).
+    """
+    n_dev = mesh.shape[axis]
+
+    def shard_fn(local, n_valid, mask_s, mask_l):
+        idx = jax.lax.axis_index(axis)
+        shard_len = local.shape[0]
+        # left neighbour's tail rides the ring: shard i sends its last 31
+        # bytes to shard i+1.
+        tail = jax.lax.ppermute(
+            local[-_HALO:], axis,
+            perm=[(i, (i + 1) % n_dev) for i in range(n_dev)])
+        # shard 0 receives the last shard's tail — garbage, but it only
+        # perturbs h[0..30], positions that can never be cuts (min_size > 31)
+        ext = jnp.concatenate([tail, local])
+        start = idx.astype(jnp.int32) * shard_len
+        h = _hash_ext_fast(ext)
+        words_l, words_s = _candidate_words(h, n_valid - start, mask_s, mask_l)
+        nz = words_l != 0
+        (widx,) = jnp.nonzero(nz, size=k_cap_per_shard, fill_value=-1)
+        nz_words = jnp.sum(nz.astype(jnp.int32))
+        safe = jnp.clip(widx, 0, words_l.shape[0] - 1)
+        abs_widx = jnp.where(widx >= 0, widx + start // 32, widx)
+        return (abs_widx[None], words_l[safe][None], words_s[safe][None],
+                nz_words[None])
+
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(axis), P(), P(), P()),
+        out_specs=(P(axis), P(axis), P(axis), P(axis)),
+    )
+    return jax.jit(mapped)
+
+
+def chunk_stream_sharded(data, mesh: Mesh, params: Optional[CDCParams] = None,
+                         axis: str = "data"):
+    """Host convenience: chunk one long stream across all devices of ``mesh``.
+
+    Bit-identical to the CPU oracle; used by tests and the multi-chip dryrun.
+    """
+    params = params or CDCParams()
+    if params.min_size < GEAR_WINDOW:
+        raise ValueError(f"TPU chunker requires min_size >= {GEAR_WINDOW}")
+    n = len(data)
+    if n >= 2**31:
+        # positions are tracked in (x64-disabled) int32 on device; larger
+        # streams go through the segmented scanner, which is still exact.
+        return TpuCdcScanner(params).chunk_stream(data)
+    n_dev = mesh.shape[axis]
+    padded = _round_up(max(n, 1), n_dev * 1024)
+    buf = np.zeros(padded, dtype=np.uint8)
+    buf[:n] = np.frombuffer(bytes(data), dtype=np.uint8)
+    # nearly every sparse candidate lands in its own 32-bit word, so size
+    # capacity by candidate count, not candidate/32
+    k_cap = max(512, _round_up(
+        16 * max(1, (padded // n_dev) >> params.mask_l_bits), 512))
+    scan = make_sharded_scanner(mesh, axis, k_cap_per_shard=k_cap)
+    stream = jax.device_put(jnp.asarray(buf), NamedSharding(mesh, P(axis)))
+    widx, wl, ws, nz_words = scan(stream, jnp.int32(n),
+                                  jnp.uint32(params.mask_s),
+                                  jnp.uint32(params.mask_l))
+    if (np.asarray(nz_words) > k_cap).any():  # overflow: oracle, still exact
+        from .cdc_cpu import chunk_stream as cpu_chunk
+        return cpu_chunk(data, params)
+    pos_parts, s_parts = [], []
+    for d in range(n_dev):
+        p, s = _decode_words(widx[d], wl[d], ws[d], k_cap, 0)
+        pos_parts.append(p)
+        s_parts.append(s)
+    pos_l = np.concatenate(pos_parts)
+    is_s = np.concatenate(s_parts)
+    order = np.argsort(pos_l, kind="stable")
+    pos_l, is_s = pos_l[order], is_s[order]
+    return cuts_to_chunks(select_cuts(pos_l[is_s], pos_l, n, params))

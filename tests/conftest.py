@@ -7,12 +7,18 @@ on host-platform virtual devices (SURVEY.md section 7 / the driver's
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The environment's sitecustomize re-pins JAX_PLATFORMS to the hardware
+# plugin after env setup; the config API wins over both.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import random
 

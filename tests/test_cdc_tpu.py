@@ -1,0 +1,69 @@
+"""TPU (device-path) CDC scan must be bit-identical to the CPU oracle."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from backuwup_tpu.ops import cdc_cpu
+from backuwup_tpu.ops.cdc_tpu import (
+    TpuCdcScanner,
+    chunk_stream_sharded,
+    gear_hashes_tpu,
+)
+from backuwup_tpu.ops.gear import CDCParams
+
+SMALL = CDCParams.from_desired(4096)  # min 1024 / desired 4096 / max 12288
+
+
+def _data(n, seed=7):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+@pytest.mark.parametrize("n", [0, 1, 31, 32, 33, 1000, 4096, 65536, 200_000])
+def test_hashes_match_oracle(n):
+    data = _data(n)
+    np.testing.assert_array_equal(gear_hashes_tpu(data),
+                                  cdc_cpu.gear_hashes(data))
+
+
+def test_hashes_with_halo():
+    data = _data(10_000)
+    tail, rest = data[:5000], data[5000:]
+    got = gear_hashes_tpu(rest, prev_tail=tail)
+    np.testing.assert_array_equal(got, cdc_cpu.gear_hashes(data)[5000:])
+
+
+@pytest.mark.parametrize("n", [0, 1, 1023, 1024, 5000, 200_000, 1_000_000])
+def test_chunks_match_oracle(n):
+    data = _data(n, seed=n or 1)
+    scanner = TpuCdcScanner(SMALL)
+    assert scanner.chunk_stream(data) == cdc_cpu.chunk_stream(data, SMALL)
+
+
+def test_chunks_multi_segment():
+    # Segment smaller than the stream forces the carried-halo path.
+    data = _data(300_000, seed=3)
+    scanner = TpuCdcScanner(SMALL, segment_size=65536)
+    assert scanner.chunk_stream(data) == cdc_cpu.chunk_stream(data, SMALL)
+
+
+def test_chunk_invariants():
+    data = _data(500_000, seed=9)
+    chunks = TpuCdcScanner(SMALL).chunk_stream(data)
+    assert sum(c[1] for c in chunks) == len(data)
+    offsets = [c[0] for c in chunks]
+    assert offsets == sorted(offsets)
+    for off, ln in chunks[:-1]:
+        assert SMALL.min_size <= ln <= SMALL.max_size
+    assert chunks[-1][1] <= SMALL.max_size
+
+
+def test_sharded_scan_matches_oracle():
+    devs = jax.devices()
+    assert len(devs) == 8, "conftest must provide 8 virtual devices"
+    mesh = jax.sharding.Mesh(np.array(devs), ("data",))
+    for n in (0, 1, 100_000, 777_777):
+        data = _data(n, seed=n or 2)
+        assert (chunk_stream_sharded(data, mesh, SMALL)
+                == cdc_cpu.chunk_stream(data, SMALL))
