@@ -1,0 +1,144 @@
+"""Crypto & identity: root secret -> every key in the system.
+
+Re-designs the reference key manager (``client/src/key_manager.rs:32-87``)
+and mnemonic identity flow (``client/src/ui/cli.rs:26-77``):
+
+* A single 32-byte **root secret** seeds a ChaCha20 deterministic stream;
+  the first 32 bytes become the Ed25519 signing seed (the public key doubles
+  as the client identity, ``shared/src/types.rs:4-10``), the next 32 the
+  symmetric **backup secret**.
+* Every content key is derived from the backup secret with HKDF-SHA256 and a
+  context string (``key_manager.rs:80-86``): per-blob keys use the blob hash
+  as context, the packfile-header key uses ``b"header"``, the index key
+  ``b"index"`` (``packfile/pack.rs:58-79``, ``blob_index.rs:16-19``).
+* The root secret round-trips through a human-readable **recovery phrase**
+  (the reference prints a BIP39 mnemonic, ``cli.rs:55-77``; here a
+  self-contained Crockford-base32 group code with a checksum, since identity
+  restore must not depend on an external wordlist).
+
+Host-side only: crypto is I/O-path work, not TPU compute (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers import Cipher
+from cryptography.hazmat.primitives.ciphers.algorithms import ChaCha20
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+ROOT_SECRET_LEN = 32
+KEY_LEN = 32
+
+
+def _chacha_stream(seed: bytes, length: int) -> bytes:
+    """Deterministic expansion of the root secret (CSPRNG analog of the
+    reference's seeded rand_chacha, ``key_manager.rs:42-49``)."""
+    cipher = Cipher(ChaCha20(seed, b"\x00" * 16), mode=None)
+    return cipher.encryptor().update(b"\x00" * length)
+
+
+def hkdf_derive(secret: bytes, info: bytes, length: int = KEY_LEN) -> bytes:
+    """HKDF-SHA256(extract(no salt) || expand(info)) — key_manager.rs:80-86."""
+    return HKDF(algorithm=hashes.SHA256(), length=length, salt=None,
+                info=info).derive(secret)
+
+
+@dataclass(frozen=True)
+class KeyManager:
+    """All client keys, deterministically derived from the root secret."""
+
+    root_secret: bytes
+    signing_key: Ed25519PrivateKey
+    backup_secret: bytes
+
+    @classmethod
+    def generate(cls) -> "KeyManager":
+        return cls.from_secret(os.urandom(ROOT_SECRET_LEN))
+
+    @classmethod
+    def from_secret(cls, root_secret: bytes) -> "KeyManager":
+        if len(root_secret) != ROOT_SECRET_LEN:
+            raise ValueError("root secret must be 32 bytes")
+        stream = _chacha_stream(root_secret, 64)
+        signing_key = Ed25519PrivateKey.from_private_bytes(stream[:32])
+        return cls(root_secret=bytes(root_secret), signing_key=signing_key,
+                   backup_secret=stream[32:64])
+
+    @property
+    def client_id(self) -> bytes:
+        """32-byte Ed25519 public key == identity (types.rs:4-10)."""
+        return self.signing_key.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+
+    def sign(self, message: bytes) -> bytes:
+        return self.signing_key.sign(bytes(message))
+
+    def derive_backup_key(self, info: bytes, length: int = KEY_LEN) -> bytes:
+        return hkdf_derive(self.backup_secret, bytes(info), length)
+
+
+def verify_signature(client_id: bytes, message: bytes, signature: bytes) -> bool:
+    """Ed25519 verify; mirrors ``verify_strict`` use at every trust boundary
+    (``net_p2p/handle_connections.rs:194-204``, server
+    ``client_auth_manager.rs:74-78``)."""
+    try:
+        Ed25519PublicKey.from_public_bytes(bytes(client_id)).verify(
+            bytes(signature), bytes(message))
+        return True
+    except Exception:
+        return False
+
+
+# --------------------------------------------------------------------------
+# Recovery phrase: Crockford-base32 groups + checksum (BIP39-mnemonic analog)
+# --------------------------------------------------------------------------
+
+_B32 = "0123456789abcdefghjkmnpqrstvwxyz"  # Crockford (no i, l, o, u)
+_B32_INV = {c: i for i, c in enumerate(_B32)}
+_B32_INV.update({"i": 1, "l": 1, "o": 0})  # transcription forgiveness
+_CHECK_LEN = 4
+_GROUP = 8
+
+
+def _checksum(secret: bytes) -> str:
+    tag = hmac.new(b"backuwup-recovery-v1", secret, "sha256").digest()
+    v = int.from_bytes(tag[:4], "big")
+    return "".join(_B32[(v >> (5 * i)) & 31] for i in range(_CHECK_LEN))
+
+
+def secret_to_phrase(secret: bytes) -> str:
+    """32-byte secret -> 13 dash-separated groups (52 data + 4 check chars)."""
+    if len(secret) != ROOT_SECRET_LEN:
+        raise ValueError("root secret must be 32 bytes")
+    v = int.from_bytes(secret, "big")
+    chars = "".join(_B32[(v >> (5 * i)) & 31] for i in range(52))  # 260 bits
+    chars += _checksum(secret)
+    return "-".join(chars[i:i + _GROUP] for i in range(0, len(chars), _GROUP))
+
+
+def phrase_to_secret(phrase: str) -> bytes:
+    """Inverse of :func:`secret_to_phrase`; raises ValueError on typos."""
+    chars = phrase.strip().lower().replace("-", "").replace(" ", "")
+    if len(chars) != 52 + _CHECK_LEN:
+        raise ValueError("recovery phrase must have 56 characters")
+    try:
+        digits = [_B32_INV[c] for c in chars]
+    except KeyError as e:
+        raise ValueError(f"invalid character in recovery phrase: {e}") from None
+    v = 0
+    for i, d in enumerate(digits[:52]):
+        v |= d << (5 * i)
+    if v >= 1 << 256:
+        raise ValueError("recovery phrase out of range")
+    secret = v.to_bytes(32, "big")
+    if "".join(_B32[d] for d in digits[52:]) != _checksum(secret):
+        raise ValueError("recovery phrase checksum mismatch")
+    return secret

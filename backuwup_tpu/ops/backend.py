@@ -1,0 +1,152 @@
+"""ChunkerBackend: one dedup-pipeline contract, CPU and TPU executions.
+
+``BASELINE.json`` pins the seam: a backend turns raw bytes into chunk
+manifests (cut points + BLAKE3 fingerprints); everything above it — snapshot
+builder, packfiles, peer exchange — is backend-agnostic.  The reference has
+only the sequential CPU form (``dir_packer.rs:246-311``); here:
+
+* :class:`CpuBackend` — the numpy oracle pipeline (also the honest baseline
+  for the 10x target; see ``bench.py``).
+* :class:`TpuBackend` — device gear-scan (:mod:`.cdc_tpu`) + batched
+  device BLAKE3 (:mod:`.blake3_tpu`).  Files are processed as batches so
+  fingerprinting amortizes into a few bucketed compiles.
+* :func:`select_backend` — picks TPU when an accelerator is attached,
+  otherwise CPU; both produce bit-identical manifests, so the choice is
+  pure policy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence
+
+from .blake3_cpu import blake3_many
+from .blake3_tpu import blake3_many_tpu
+from .cdc_cpu import chunk_stream as chunk_stream_cpu
+from .cdc_tpu import TpuCdcScanner
+from .gear import CDCParams
+
+
+@dataclass(frozen=True)
+class ChunkRef:
+    """One chunk of one stream: location + fingerprint."""
+
+    offset: int
+    length: int
+    hash: bytes
+
+
+class ChunkerBackend:
+    """Contract: ``manifest(data) -> [ChunkRef...]``, batched over streams."""
+
+    name = "abstract"
+
+    def chunk(self, data) -> List[tuple]:
+        raise NotImplementedError
+
+    def digest_many(self, datas: Sequence[bytes]) -> List[bytes]:
+        raise NotImplementedError
+
+    def manifest_many(self, streams: Sequence[bytes]) -> List[List[ChunkRef]]:
+        """Chunk + fingerprint a batch of streams in one pipeline pass."""
+        all_chunks = []  # (stream_idx, offset, length)
+        pieces = []
+        for i, data in enumerate(streams):
+            for off, ln in self.chunk(data):
+                all_chunks.append((i, off, ln))
+                pieces.append(bytes(data[off:off + ln]))
+        digests = self.digest_many(pieces)
+        out: List[List[ChunkRef]] = [[] for _ in streams]
+        for (i, off, ln), h in zip(all_chunks, digests):
+            out[i].append(ChunkRef(offset=off, length=ln, hash=h))
+        return out
+
+    def manifest(self, data) -> List[ChunkRef]:
+        return self.manifest_many([data])[0]
+
+    def manifest_stream(self, read: Callable[[int], bytes],
+                        segment_bytes: int = 256 * 1024 * 1024,
+                        emit: Optional[Callable] = None) -> List[ChunkRef]:
+        """Chunk + fingerprint a stream without holding it in memory.
+
+        ``read(n)`` returns up to ``n`` bytes ('' at EOF).  Works because a
+        CDC cut depends only on bytes up to the cut: chunking a prefix gives
+        final chunks except the last (whose end might be EOF-forced), which
+        is carried into the next segment.  Bit-identical to chunking the
+        whole stream at once.  ``emit(ref, chunk_bytes)`` fires per final
+        chunk as soon as it is fingerprinted (lets the caller pack blobs
+        incrementally); the returned list is the full manifest.
+        """
+        out: List[ChunkRef] = []
+        carry = b""
+        base = 0  # absolute offset of carry[0]
+        while True:
+            segment = read(segment_bytes)
+            eof = not segment
+            buf = carry + segment
+            chunks = self.chunk(buf)
+            if eof:
+                final, carry, next_base = chunks, b"", base
+            elif len(chunks) > 1:
+                final = chunks[:-1]
+                last_off = chunks[-1][0]
+                carry, next_base = buf[last_off:], base + last_off
+            else:
+                # single chunk that may still grow: carry everything
+                final, carry, next_base = [], buf, base
+            pieces = [buf[off:off + ln] for off, ln in final]
+            for h, (off, ln), data in zip(self.digest_many(pieces), final,
+                                          pieces):
+                ref = ChunkRef(offset=base + off, length=ln, hash=h)
+                out.append(ref)
+                if emit is not None:
+                    emit(ref, data)
+            base = next_base
+            if eof:
+                break
+        return out
+
+
+class CpuBackend(ChunkerBackend):
+    name = "cpu"
+
+    def __init__(self, params: Optional[CDCParams] = None):
+        self.params = params or CDCParams()
+
+    def chunk(self, data):
+        return chunk_stream_cpu(data, self.params)
+
+    def digest_many(self, datas):
+        return blake3_many(datas)
+
+
+class TpuBackend(ChunkerBackend):
+    name = "tpu"
+
+    def __init__(self, params: Optional[CDCParams] = None):
+        self.params = params or CDCParams()
+        self._scanner = TpuCdcScanner(self.params)
+
+    def chunk(self, data):
+        return self._scanner.chunk_stream(data)
+
+    def digest_many(self, datas):
+        return blake3_many_tpu(datas)
+
+
+def _accelerator_attached() -> bool:
+    try:
+        import jax
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def select_backend(prefer: Optional[str] = None,
+                   params: Optional[CDCParams] = None) -> ChunkerBackend:
+    """``prefer`` in {"cpu", "tpu", None}; None = auto-detect."""
+    if prefer == "cpu":
+        return CpuBackend(params)
+    if prefer == "tpu":
+        return TpuBackend(params)
+    return TpuBackend(params) if _accelerator_attached() else CpuBackend(params)

@@ -1,0 +1,263 @@
+"""Batched BLAKE3 on TPU: the fingerprint stage of the dedup pipeline.
+
+The reference hashes every chunk and tree blob with the SIMD ``blake3`` crate
+(``client/src/backup/filesystem/dir_packer.rs:286,321,353``), one chunk at a
+time.  Here many independent inputs are digested in one device program:
+
+* Each input is padded to ``L`` 1 KiB leaf chunks; a batch is ``(B, L*1024)``
+  u8.  The compression function is vectorized over ``B*L`` lanes as pure u32
+  VPU arithmetic (rotates = shift pairs), with the 7 rounds and the message
+  permutation schedule unrolled at trace time.
+* The leaf scan walks the 16 blocks of every chunk in lock-step; per-lane
+  masks (block counts, last-block lengths, CHUNK_START/END/ROOT flags)
+  make digests exact for every input length, including 0.
+* The binary tree reduction pair-merges chaining values level by level;
+  an unpaired rightmost node rides up unchanged, which reproduces BLAKE3's
+  largest-power-of-two-left split exactly (see blake3_cpu.py docstring).
+* Structure and masking mirror :class:`backuwup_tpu.ops.blake3_cpu.Blake3Numpy`
+  line for line, and digests are bit-identical to the scalar spec
+  implementation — self-consistent dedup requires nothing less.
+
+Batching policy lives in :func:`bucketed_batches`: variable-size CDC chunks
+(256 KiB..3 MiB for default params) are grouped into a handful of (B, L)
+compiled shapes (``defaults.BLAKE3_LEAF_BUCKETS``) to bound both padding
+waste and XLA recompiles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import defaults
+from .blake3_cpu import (
+    BLOCK_LEN,
+    CHUNK_END,
+    CHUNK_LEN,
+    CHUNK_START,
+    G_SCHEDULE,
+    IV,
+    MAX_LEAVES_PER_CHUNK,
+    MSG_PERMUTATION,
+    PARENT,
+    ROOT,
+)
+
+_IV_NP = np.array(IV, dtype=np.uint32)
+
+
+def _rotr(x, n: int):
+    return (x >> jnp.uint32(n)) | (x << jnp.uint32(32 - n))
+
+
+def _compress_cols(cv, m, counter_lo, counter_hi, block_len, flags):
+    """One BLAKE3 compression, vectorized over lanes.
+
+    ``cv``: list of 8 u32 arrays; ``m``: list of 16 u32 arrays; the scalars
+    are u32 arrays of the same lane shape.  Columns stay as separate SSA
+    values so XLA fuses the whole round structure without scatter ops.
+    Returns the 8 output chaining-value columns.
+    """
+    iv = [jnp.broadcast_to(jnp.uint32(_IV_NP[i]), counter_lo.shape)
+          for i in range(4)]
+    state = [c + jnp.uint32(0) for c in cv] + iv + [counter_lo, counter_hi,
+                                                    block_len, flags]
+    m = [w + jnp.uint32(0) for w in m]
+
+    def round_body(_, carry):
+        state, m = list(carry[0]), list(carry[1])
+        for i, (a, b, c, d) in enumerate(G_SCHEDULE):
+            mx, my = m[2 * i], m[2 * i + 1]
+            state[a] = state[a] + state[b] + mx
+            state[d] = _rotr(state[d] ^ state[a], 16)
+            state[c] = state[c] + state[d]
+            state[b] = _rotr(state[b] ^ state[c], 12)
+            state[a] = state[a] + state[b] + my
+            state[d] = _rotr(state[d] ^ state[a], 8)
+            state[c] = state[c] + state[d]
+            state[b] = _rotr(state[b] ^ state[c], 7)
+        # permuting after the final round too is harmless (m is dropped);
+        # keeping it unconditional lets the 7 rounds share one loop body
+        return tuple(state), tuple(m[p] for p in MSG_PERMUTATION)
+
+    state, _ = jax.lax.fori_loop(0, 7, round_body, (tuple(state), tuple(m)))
+    return [state[i] ^ state[i + 8] for i in range(8)]
+
+
+def _bytes_to_words(buf: jnp.ndarray) -> jnp.ndarray:
+    """(..., 4k) u8 -> (..., k) u32 little-endian."""
+    b = buf.reshape(*buf.shape[:-1], -1, 4).astype(jnp.uint32)
+    return (b[..., 0] | (b[..., 1] << jnp.uint32(8))
+            | (b[..., 2] << jnp.uint32(16)) | (b[..., 3] << jnp.uint32(24)))
+
+
+@functools.partial(jax.jit, static_argnames=("L",))
+def digest_padded(buf: jnp.ndarray, lens: jnp.ndarray, *, L: int) -> jnp.ndarray:
+    """Digest a zero-padded batch.
+
+    ``buf``: (B, L*1024) u8; ``lens``: (B,) true byte lengths (i32).
+    Returns (B, 8) u32 root chaining values (little-endian digest words).
+
+    The 16-block leaf scan runs as a ``fori_loop`` (compile-time: one
+    compression in the graph, not 16); the single-chunk ROOT variant is
+    produced by stashing the last block's inputs during the scan and
+    recompressing once over B lanes afterwards, instead of running a second
+    full scan.  Tree levels are unrolled (log2 L of them) with the
+    PARENT|ROOT compression computed only for pair 0, the only pair that can
+    ever finalize the root.
+    """
+    B = buf.shape[0]
+    words = _bytes_to_words(buf.reshape(B, L, MAX_LEAVES_PER_CHUNK, BLOCK_LEN))
+    lanes = B * L
+    words_flat = words.reshape(lanes, MAX_LEAVES_PER_CHUNK, 16)
+    lens = lens.astype(jnp.int32)
+    n_chunks = jnp.maximum(1, -(-lens // CHUNK_LEN))  # (B,)
+    chunk_idx = jnp.arange(L, dtype=jnp.int32)
+    chunk_bytes = jnp.clip(lens[:, None] - chunk_idx[None, :] * CHUNK_LEN,
+                           0, CHUNK_LEN)  # (B, L)
+    n_blocks = jnp.maximum(1, -(-chunk_bytes // BLOCK_LEN))
+    last_block_len = (chunk_bytes - (n_blocks - 1) * BLOCK_LEN).astype(jnp.uint32)
+    is_single = (n_chunks == 1)
+
+    # --- leaf scan: fori_loop over the 16 blocks, lanes = (B*L,) -----------
+    counter_lo = jnp.broadcast_to(chunk_idx[None, :].astype(jnp.uint32),
+                                  (B, L)).reshape(-1)
+    counter_hi = jnp.zeros(lanes, dtype=jnp.uint32)
+    nb = n_blocks.reshape(-1)
+    lbl = last_block_len.reshape(-1)
+    zeros = jnp.zeros(lanes, dtype=jnp.uint32)
+    iv_cols = [jnp.broadcast_to(jnp.uint32(_IV_NP[i]), (lanes,)) + zeros
+               for i in range(8)]
+
+    def leaf_body(blk, carry):
+        cv, cv_last_in, m_last, blen_last, flags_last = carry
+        mslab = jax.lax.dynamic_index_in_dim(words_flat, blk, axis=1,
+                                             keepdims=False)  # (lanes, 16)
+        m = [mslab[:, w] for w in range(16)]
+        active = blk < nb
+        is_last = blk == nb - 1
+        flags = jnp.where(blk == 0, jnp.uint32(CHUNK_START), jnp.uint32(0))
+        flags = jnp.where(is_last, flags | jnp.uint32(CHUNK_END), flags)
+        blen = jnp.where(is_last, lbl, jnp.uint32(BLOCK_LEN))
+        # stash the *inputs* of each chunk's final compression for the
+        # single-chunk ROOT recompute after the loop
+        cv_last_in = [jnp.where(is_last, c, s)
+                      for c, s in zip(cv, cv_last_in)]
+        m_last = [jnp.where(is_last, mw, sw) for mw, sw in zip(m, m_last)]
+        blen_last = jnp.where(is_last, blen, blen_last)
+        flags_last = jnp.where(is_last, flags, flags_last)
+        out = _compress_cols(cv, m, counter_lo, counter_hi, blen, flags)
+        cv = [jnp.where(active, o, c) for o, c in zip(out, cv)]
+        return cv, cv_last_in, m_last, blen_last, flags_last
+
+    init = (iv_cols, list(iv_cols), [zeros] * 16, zeros, zeros)
+    cv, cv_last_in, m_last, blen_last, flags_last = jax.lax.fori_loop(
+        0, MAX_LEAVES_PER_CHUNK, leaf_body, init)
+    leaf_cv = [c.reshape(B, L) for c in cv]
+
+    # single-chunk roots: recompress chunk 0's final block with ROOT set
+    def chunk0(col):
+        return col.reshape(B, L)[:, 0]
+
+    root_single = _compress_cols(
+        [chunk0(c) for c in cv_last_in], [chunk0(mw) for mw in m_last],
+        jnp.zeros(B, dtype=jnp.uint32), jnp.zeros(B, dtype=jnp.uint32),
+        chunk0(blen_last), chunk0(flags_last) | jnp.uint32(ROOT))
+
+    # --- tree reduction: pair-merge, unpaired node rides up ----------------
+    root_cv = [jnp.where(is_single, rs, jnp.uint32(0))
+               for rs in root_single]
+    cvs = leaf_cv  # list of 8 (B, cur) arrays
+    counts = n_chunks
+    cur = L
+    while cur > 1:
+        Pn = cur // 2
+        left = [c[:, 0:2 * Pn:2] for c in cvs]   # (B, Pn)
+        right = [c[:, 1:2 * Pn:2] for c in cvs]
+        m = [l.reshape(-1) for l in left] + [r.reshape(-1) for r in right]
+        lanes_p = B * Pn
+        zero = jnp.zeros(lanes_p, dtype=jnp.uint32)
+        ivc = [jnp.broadcast_to(jnp.uint32(_IV_NP[i]), (lanes_p,))
+               for i in range(8)]
+        bl = jnp.full(lanes_p, BLOCK_LEN, dtype=jnp.uint32)
+        merged = _compress_cols(ivc, m, zero, zero, bl,
+                                jnp.full(lanes_p, PARENT, dtype=jnp.uint32))
+        merged = [x.reshape(B, Pn) for x in merged]
+        # the root merge (count 2 -> 1) always happens at pair 0
+        zb = jnp.zeros(B, dtype=jnp.uint32)
+        merged_root0 = _compress_cols(
+            [jnp.broadcast_to(jnp.uint32(_IV_NP[i]), (B,)) for i in range(8)],
+            [l[:, 0] for l in left] + [r[:, 0] for r in right],
+            zb, zb, jnp.full(B, BLOCK_LEN, dtype=jnp.uint32),
+            jnp.full(B, PARENT | ROOT, dtype=jnp.uint32))
+        pair_idx = jnp.arange(Pn, dtype=jnp.int32)
+        pair_merges = (2 * pair_idx[None, :] + 1) < counts[:, None]  # (B, Pn)
+        nxt = []
+        for ci in range(8):
+            col = jnp.where(pair_merges, merged[ci], left[ci])
+            if cur % 2:
+                col = jnp.concatenate([col, cvs[ci][:, -1:]], axis=1)
+            nxt.append(col)
+        is_root_merge = (counts == 2)
+        root_cv = [jnp.where(is_root_merge, mr0, rc)
+                   for mr0, rc in zip(merged_root0, root_cv)]
+        cvs = nxt
+        counts = jnp.where(counts > 1, (counts + 1) // 2, counts)
+        cur = (cur + 1) // 2
+
+    return jnp.stack(root_cv, axis=1)  # (B, 8) u32
+
+
+def _root_cv_to_digests(root_cv: np.ndarray) -> list:
+    out = np.ascontiguousarray(root_cv.astype("<u4")).tobytes()
+    return [out[i * 32:(i + 1) * 32] for i in range(root_cv.shape[0])]
+
+
+def _leaf_bucket(n_bytes: int) -> int:
+    """Smallest configured (B, L) leaf bucket holding ``n_bytes``."""
+    n_chunks = max(1, -(-n_bytes // CHUNK_LEN))
+    for b in defaults.BLAKE3_LEAF_BUCKETS:
+        if n_chunks <= b:
+            return b
+    return n_chunks  # oversized input: exact-size compile
+
+
+def _batch_bucket(n: int) -> int:
+    """Batch sizes are padded to powers of two (>=8) to bound recompiles."""
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+def bucketed_batches(datas):
+    """Group inputs by leaf bucket; yields (indices, buf, lens, L)."""
+    groups = {}
+    for i, d in enumerate(datas):
+        groups.setdefault(_leaf_bucket(len(d)), []).append(i)
+    for L, idxs in sorted(groups.items()):
+        B = _batch_bucket(len(idxs))
+        buf = np.zeros((B, L * CHUNK_LEN), dtype=np.uint8)
+        lens = np.zeros(B, dtype=np.int32)
+        for row, i in enumerate(idxs):
+            d = datas[i]
+            buf[row, :len(d)] = np.frombuffer(bytes(d), dtype=np.uint8)
+            lens[row] = len(d)
+        yield idxs, buf, lens, L
+
+
+def blake3_many_tpu(datas) -> list:
+    """Batched digests on the device; bit-exact vs
+    :func:`backuwup_tpu.ops.blake3_cpu.blake3_hash`."""
+    datas = list(datas)
+    out = [None] * len(datas)
+    for idxs, buf, lens, L in bucketed_batches(datas):
+        root = np.asarray(digest_padded(jnp.asarray(buf), jnp.asarray(lens),
+                                        L=L))
+        digests = _root_cv_to_digests(root)
+        for row, i in enumerate(idxs):
+            out[i] = digests[row]
+    return out

@@ -307,10 +307,11 @@ def make_sharded_scanner(mesh: Mesh, axis: str = "data", *,
 
 
 def chunk_stream_sharded(data, mesh: Mesh, params: Optional[CDCParams] = None,
-                         axis: str = "data"):
+                         axis: str = "data", k_cap: Optional[int] = None):
     """Host convenience: chunk one long stream across all devices of ``mesh``.
 
     Bit-identical to the CPU oracle; used by tests and the multi-chip dryrun.
+    ``k_cap`` overrides the per-shard sparse capacity (tests force overflow).
     """
     params = params or CDCParams()
     if params.min_size < GEAR_WINDOW:
@@ -326,8 +327,9 @@ def chunk_stream_sharded(data, mesh: Mesh, params: Optional[CDCParams] = None,
     buf[:n] = np.frombuffer(bytes(data), dtype=np.uint8)
     # nearly every sparse candidate lands in its own 32-bit word, so size
     # capacity by candidate count, not candidate/32
-    k_cap = max(512, _round_up(
-        16 * max(1, (padded // n_dev) >> params.mask_l_bits), 512))
+    if k_cap is None:
+        k_cap = max(512, _round_up(
+            16 * max(1, (padded // n_dev) >> params.mask_l_bits), 512))
     scan = make_sharded_scanner(mesh, axis, k_cap_per_shard=k_cap)
     stream = jax.device_put(jnp.asarray(buf), NamedSharding(mesh, P(axis)))
     widx, wl, ws, nz_words = scan(stream, jnp.int32(n),

@@ -1,0 +1,1 @@
+"""Snapshot engine: packfiles, blob index, tree packing/unpacking."""

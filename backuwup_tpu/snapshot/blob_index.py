@@ -1,0 +1,168 @@
+"""Blob index: the client-side dedup authority (blob hash -> packfile).
+
+Re-designs ``client/src/backup/filesystem/packfile/blob_index.rs``:
+
+* In memory: hash -> packfile-id map plus a ``queued`` set for blobs that
+  are encrypted-and-buffered but not yet inside a written packfile
+  (``blob_index.rs:52-53,130-140``) — both consulted for dedup.
+* On disk: sequentially numbered encrypted files of at most
+  ``INDEX_FILE_MAX_ENTRIES`` entries (``blob_index.rs:16-19``); file key =
+  HKDF(backup secret, b"index"), nonce = the 12-byte little-endian file
+  counter (``blob_index.rs:183-237``), so index files are tamper-evident
+  and positionally bound.
+* The index is a cache: it can always be rebuilt from packfile headers
+  (``blob_index.rs:23-43``) — :meth:`BlobIndex.rebuild_from_packfiles`.
+
+This in-memory map is the CPU fallback of the dedup lookup; the sharded
+TPU HBM probe (:mod:`backuwup_tpu.ops.dedup_index`) accelerates the same
+contract for huge indexes.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from .. import defaults
+from ..crypto import KeyManager
+from ..utils.serialization import Reader, Writer
+from ..wire import BLOB_HASH_LEN, PACKFILE_ID_LEN
+
+INDEX_KEY_INFO = b"index"
+_NAME_RE = re.compile(r"^\d{6}$")
+
+
+def index_file_name(counter: int) -> str:
+    """Zero-padded numbering (file_utils.rs:55-57)."""
+    return f"{counter:06d}"
+
+
+class BlobIndex:
+    def __init__(self, keys: KeyManager, index_dir: Path):
+        self.index_dir = Path(index_dir)
+        self._key = keys.derive_backup_key(INDEX_KEY_INFO)
+        self._map: Dict[bytes, bytes] = {}
+        self._queued: Set[bytes] = set()
+        self._unsaved: List[tuple] = []
+        # Never reuse a file counter: the counter is the AES-GCM nonce, and a
+        # (key, nonce) pair must encrypt exactly one plaintext.  Scan the
+        # directory up front so even recovery paths that skip load() (e.g.
+        # rebuild_from_packfiles after a corrupt file) keep counters fresh.
+        self._next_file = self._scan_next_file()
+
+    def _scan_next_file(self) -> int:
+        if not self.index_dir.is_dir():
+            return 0
+        numbers = [int(p.name) for p in self.index_dir.iterdir()
+                   if _NAME_RE.match(p.name)]
+        return max(numbers) + 1 if numbers else 0
+
+    # --- dedup contract (blob_index.rs:130-148) ----------------------------
+
+    def is_duplicate(self, blob_hash: bytes) -> bool:
+        h = bytes(blob_hash)
+        return h in self._map or h in self._queued
+
+    def mark_queued(self, blob_hash: bytes) -> None:
+        self._queued.add(bytes(blob_hash))
+
+    def finalize_packfile(self, packfile_id: bytes,
+                          blob_hashes: Iterable[bytes]) -> None:
+        """Blobs of a just-written packfile become committed entries."""
+        pid = bytes(packfile_id)
+        for h in blob_hashes:
+            h = bytes(h)
+            self._queued.discard(h)
+            if h not in self._map:
+                self._map[h] = pid
+                self._unsaved.append((h, pid))
+
+    def lookup(self, blob_hash: bytes) -> Optional[bytes]:
+        return self._map.get(bytes(blob_hash))
+
+    def packfile_ids(self) -> Set[bytes]:
+        return set(self._map.values())
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    @property
+    def unsaved_entries(self) -> int:
+        return len(self._unsaved)
+
+    # --- encrypted split persistence (blob_index.rs:183-237) ---------------
+
+    def _nonce(self, counter: int) -> bytes:
+        return counter.to_bytes(PACKFILE_ID_LEN, "little")
+
+    def flush(self) -> List[Path]:
+        """Write unsaved entries into new numbered files (<=50k each).
+
+        Returns the paths written — the send pipeline watermarks these by
+        number (``config/backup.rs:80-98``).
+        """
+        self.index_dir.mkdir(parents=True, exist_ok=True)
+        written = []
+        cap = defaults.INDEX_FILE_MAX_ENTRIES
+        while self._unsaved:
+            batch, self._unsaved = self._unsaved[:cap], self._unsaved[cap:]
+            w = Writer()
+            w.u64(len(batch))
+            for h, pid in batch:
+                w.fixed(h)
+                w.fixed(pid)
+            ct = AESGCM(self._key).encrypt(self._nonce(self._next_file),
+                                           w.take(), None)
+            path = self.index_dir / index_file_name(self._next_file)
+            tmp = path.with_suffix(".tmp")
+            tmp.write_bytes(ct)
+            os.replace(tmp, path)
+            written.append(path)
+            self._next_file += 1
+        return written
+
+    def load(self) -> int:
+        """Read every index file in numeric order; returns entry count."""
+        if not self.index_dir.is_dir():
+            return 0
+        files = sorted(p for p in self.index_dir.iterdir()
+                       if _NAME_RE.match(p.name))
+        for path in files:
+            counter = int(path.name)
+            plain = AESGCM(self._key).decrypt(self._nonce(counter),
+                                              path.read_bytes(), None)
+            r = Reader(plain)
+            for _ in range(r.u64()):
+                h = r.fixed(BLOB_HASH_LEN)
+                pid = r.fixed(PACKFILE_ID_LEN)
+                self._map.setdefault(h, pid)
+            r.expect_end()
+            self._next_file = max(self._next_file, counter + 1)
+        return len(self._map)
+
+    def rebuild_from_packfiles(self, reader, pack_dir: Path) -> int:
+        """Reconstruct the map from packfile headers (blob_index.rs:23-43).
+
+        ``reader`` is a :class:`~backuwup_tpu.snapshot.packfile.PackfileReader`
+        over ``pack_dir``.
+        """
+        pack_dir = Path(pack_dir)
+        if not pack_dir.is_dir():
+            return 0
+        for shard in sorted(pack_dir.iterdir()):
+            if not shard.is_dir():
+                continue
+            for f in sorted(shard.iterdir()):
+                try:
+                    pid = bytes.fromhex(f.name)
+                except ValueError:
+                    continue
+                if len(pid) != PACKFILE_ID_LEN:
+                    continue
+                for entry in reader.read_header(pid):
+                    self._map.setdefault(entry.hash, pid)
+        return len(self._map)

@@ -1,0 +1,239 @@
+"""Packfile write/read: dedup -> compress -> encrypt -> pack.
+
+Re-designs the reference packfile manager (``client/src/backup/filesystem/
+packfile/mod.rs:46-64``, ``pack.rs``, ``unpack.rs``) with the same on-disk
+format semantics:
+
+    u64-LE header_ct_len || AESGCM(header) || blob section
+    blob section entry:  nonce(12) || AESGCM(zstd(blob data))
+
+* per-blob key  = HKDF(backup secret, blob_hash)   (pack.rs:66-70)
+* header key    = HKDF(backup secret, b"header")   (pack.rs:206-215)
+* header nonce  = the random 12-byte packfile id   (packfile/mod.rs:25,
+  types.rs PackfileId doubles as nonce)
+* blob nonce    = random 12 bytes per blob
+* header        = sequence of PackfileHeaderBlob{hash, kind, compression,
+  length, offset} in the deterministic binary codec
+
+Write policy mirrors ``packfile/mod.rs:25-29``: flush a packfile when the
+buffered plain size crosses PACKFILE_TARGET_SIZE or PACKFILE_MAX_BLOBS,
+hard-capped at PACKFILE_MAX_SIZE.  Files shard into ``pack/<2 hex>/<hex>``
+directories (``file_utils.rs:40-52``).
+
+An unflushed manager going out of scope is a bug in the caller; the
+reference panics in ``Drop`` (``packfile/mod.rs:86-92``), here ``close()``
+raises ``DirtyPackfileError`` if data would be lost.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Dict, List, Optional
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from .. import defaults
+from ..crypto import KeyManager
+from ..utils import zstd
+from ..utils.serialization import Reader, Writer
+from ..wire import (
+    BLOB_HASH_LEN,
+    PACKFILE_ID_LEN,
+    Blob,
+    BlobKind,
+    CompressionKind,
+    PackfileHeaderBlob,
+)
+
+HEADER_KEY_INFO = b"header"
+NONCE_LEN = 12
+
+
+class PackfileError(Exception):
+    pass
+
+
+class DirtyPackfileError(PackfileError):
+    """close() called with unflushed blobs (reference Drop panic analog)."""
+
+
+class BlobNotFoundError(PackfileError):
+    pass
+
+
+def packfile_path(base: Path, packfile_id: bytes) -> Path:
+    """pack/<2-hex>/<hex> sharding (file_utils.rs:40-52)."""
+    hexid = bytes(packfile_id).hex()
+    return Path(base) / hexid[:2] / hexid
+
+
+def _compress(data: bytes) -> tuple:
+    if zstd.available():
+        return CompressionKind.ZSTD, zstd.compress(
+            data, defaults.ZSTD_COMPRESSION_LEVEL)
+    import zlib
+    return CompressionKind.ZLIB, zlib.compress(
+        data, defaults.ZSTD_COMPRESSION_LEVEL)
+
+
+def _decompress(kind: CompressionKind, data: bytes) -> bytes:
+    if kind == CompressionKind.NONE:
+        return data
+    if kind == CompressionKind.ZSTD:
+        return zstd.decompress(data)
+    if kind == CompressionKind.ZLIB:
+        import zlib
+        return zlib.decompress(data)
+    raise PackfileError(f"unknown compression kind {kind}")
+
+
+@dataclass
+class _Pending:
+    header: PackfileHeaderBlob
+    record: bytes  # nonce || ciphertext
+    plain_len: int
+
+
+class PackfileWriter:
+    """Accumulates encrypted blobs and writes packfiles.
+
+    ``on_packfile(packfile_id, path, blob_hashes, size)`` fires after each
+    file lands on disk — the seam the send pipeline and blob index hang off.
+    """
+
+    def __init__(self, keys: KeyManager, out_dir: Path,
+                 on_packfile: Optional[Callable] = None):
+        self.keys = keys
+        self.out_dir = Path(out_dir)
+        self.on_packfile = on_packfile
+        self._pending: List[_Pending] = []
+        self._pending_plain = 0
+        self._header_key = keys.derive_backup_key(HEADER_KEY_INFO)
+        self.bytes_written = 0
+
+    @property
+    def pending_blobs(self) -> int:
+        return len(self._pending)
+
+    def add_blob(self, blob: Blob) -> None:
+        """Encrypt + queue one blob; trigger a packfile write at thresholds.
+
+        Dedup is the caller's job (the blob index) — this layer packs what
+        it is given, mirroring pack.rs:31-55's split of responsibilities.
+        """
+        comp_kind, comp = _compress(blob.data)
+        key = self.keys.derive_backup_key(blob.hash)
+        nonce = os.urandom(NONCE_LEN)
+        ct = AESGCM(key).encrypt(nonce, comp, None)
+        record = nonce + ct
+        if len(record) + NONCE_LEN > defaults.PACKFILE_MAX_SIZE:
+            raise PackfileError("single blob exceeds packfile max size")
+        header = PackfileHeaderBlob(
+            hash=blob.hash, kind=blob.kind, compression=comp_kind,
+            length=len(record), offset=0)  # offset assigned at write time
+        self._pending.append(_Pending(header, record, len(blob.data)))
+        self._pending_plain += len(blob.data)
+        if (self._pending_plain >= defaults.PACKFILE_TARGET_SIZE
+                or len(self._pending) >= defaults.PACKFILE_MAX_BLOBS):
+            self._write_packfile()
+
+    def flush(self) -> None:
+        if self._pending:
+            self._write_packfile()
+
+    def close(self) -> None:
+        if self._pending:
+            raise DirtyPackfileError(
+                f"{len(self._pending)} unflushed blobs — call flush()")
+
+    def _write_packfile(self) -> None:
+        packfile_id = os.urandom(PACKFILE_ID_LEN)
+        offset = 0
+        headers = []
+        for p in self._pending:
+            headers.append(PackfileHeaderBlob(
+                hash=p.header.hash, kind=p.header.kind,
+                compression=p.header.compression, length=p.header.length,
+                offset=offset))
+            offset += len(p.record)
+        w = Writer()
+        w.u64(len(headers))
+        for h in headers:
+            h.encode(w)
+        header_ct = AESGCM(self._header_key).encrypt(packfile_id, w.take(), None)
+        path = packfile_path(self.out_dir, packfile_id)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        with open(tmp, "wb") as f:
+            f.write(len(header_ct).to_bytes(8, "little"))
+            f.write(header_ct)
+            for p in self._pending:
+                f.write(p.record)
+        os.replace(tmp, path)
+        size = path.stat().st_size
+        if size > defaults.PACKFILE_MAX_SIZE:
+            raise PackfileError("packfile exceeded hard cap — policy bug")
+        self.bytes_written += size
+        hashes = [h.hash for h in headers]
+        self._pending = []
+        self._pending_plain = 0
+        if self.on_packfile is not None:
+            self.on_packfile(packfile_id, path, hashes, size)
+
+
+class PackfileReader:
+    """Random access to blobs in a directory of packfiles (unpack.rs:23-83)."""
+
+    def __init__(self, keys: KeyManager, base_dir: Path):
+        self.keys = keys
+        self.base_dir = Path(base_dir)
+        self._header_key = keys.derive_backup_key(HEADER_KEY_INFO)
+        self._header_cache: Dict[bytes, list] = {}
+
+    def read_header(self, packfile_id: bytes) -> list:
+        pid = bytes(packfile_id)
+        if pid in self._header_cache:
+            return self._header_cache[pid]
+        path = packfile_path(self.base_dir, pid)
+        with open(path, "rb") as f:
+            hlen = int.from_bytes(f.read(8), "little")
+            header_ct = f.read(hlen)
+        plain = AESGCM(self._header_key).decrypt(pid, header_ct, None)
+        r = Reader(plain)
+        entries = [PackfileHeaderBlob.decode(r) for _ in range(r.u64())]
+        r.expect_end()
+        self._header_cache[pid] = entries
+        return entries
+
+    def get_blob(self, packfile_id: bytes, blob_hash: bytes) -> Blob:
+        entries = self.read_header(packfile_id)
+        entry = next((e for e in entries if e.hash == bytes(blob_hash)), None)
+        if entry is None:
+            raise BlobNotFoundError(bytes(blob_hash).hex())
+        path = packfile_path(self.base_dir, packfile_id)
+        with open(path, "rb") as f:
+            hlen = int.from_bytes(f.read(8), "little")
+            f.seek(8 + hlen + entry.offset)
+            record = f.read(entry.length)
+        nonce, ct = record[:NONCE_LEN], record[NONCE_LEN:]
+        key = self.keys.derive_backup_key(entry.hash)
+        data = _decompress(entry.compression, AESGCM(key).decrypt(nonce, ct, None))
+        return Blob(hash=entry.hash, kind=entry.kind, data=data)
+
+    def iter_blobs(self, packfile_id: bytes):
+        """All blobs of one packfile: one open, one sequential pass."""
+        entries = self.read_header(packfile_id)
+        path = packfile_path(self.base_dir, packfile_id)
+        with open(path, "rb") as f:
+            hlen = int.from_bytes(f.read(8), "little")
+            base = 8 + hlen
+            for entry in sorted(entries, key=lambda e: e.offset):
+                f.seek(base + entry.offset)
+                record = f.read(entry.length)
+                nonce, ct = record[:NONCE_LEN], record[NONCE_LEN:]
+                key = self.keys.derive_backup_key(entry.hash)
+                data = _decompress(entry.compression,
+                                   AESGCM(key).decrypt(nonce, ct, None))
+                yield Blob(hash=entry.hash, kind=entry.kind, data=data)

@@ -1,0 +1,261 @@
+"""Local client state store: identity, backup config, peer ledger, event log.
+
+Re-designs the reference's SQLite config layer (``client/src/config/mod.rs``,
+``identity.rs``, ``backup.rs``, ``peers.rs``, ``log.rs``) on the stdlib
+``sqlite3`` module.  Same responsibilities:
+
+* ``config`` table — typed KV: root secret, auth token, obfuscation key,
+  initialized flag, backup path, highest-sent-index watermark
+  (``config/identity.rs:85-180``, ``config/backup.rs:32-98``).
+* ``peers`` table — storage-accounting ledger per peer:
+  transmitted/received/negotiated byte counters, first/last seen
+  (``config/peers.rs:12-19``); ``find_peers_with_storage`` orders by free
+  space like ``peers.rs:176-193``.
+* ``log`` table — append-only event log doubling as restore rate-limiter and
+  backup size-estimator source (``config/log.rs:83-160``).
+
+Directory resolution honors ``CONFIG_DIR`` / ``DATA_DIR`` env vars — the
+test seam the reference uses to run N clients on one machine
+(``config/mod.rs:90-103``, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS config (
+    key TEXT PRIMARY KEY,
+    value BLOB NOT NULL
+);
+CREATE TABLE IF NOT EXISTS peers (
+    pubkey BLOB PRIMARY KEY,
+    bytes_transmitted INTEGER NOT NULL DEFAULT 0,
+    bytes_received INTEGER NOT NULL DEFAULT 0,
+    bytes_negotiated INTEGER NOT NULL DEFAULT 0,
+    first_seen REAL NOT NULL,
+    last_seen REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS log (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    timestamp REAL NOT NULL,
+    event TEXT NOT NULL,
+    data TEXT NOT NULL
+);
+"""
+
+EVENT_BACKUP = "backup"
+EVENT_RESTORE_REQUEST = "restore_request"
+
+
+def config_dir() -> Path:
+    d = os.environ.get("CONFIG_DIR")
+    return Path(d) if d else Path.home() / ".backuwup" / "config"
+
+
+def data_dir() -> Path:
+    d = os.environ.get("DATA_DIR")
+    return Path(d) if d else Path.home() / ".backuwup" / "data"
+
+
+@dataclass(frozen=True)
+class PeerInfo:
+    """config/peers.rs:12-19."""
+
+    pubkey: bytes
+    bytes_transmitted: int
+    bytes_received: int
+    bytes_negotiated: int
+    first_seen: float
+    last_seen: float
+
+    @property
+    def free_storage(self) -> int:
+        return max(0, self.bytes_negotiated - self.bytes_transmitted)
+
+
+class Store:
+    """One client's persistent local state."""
+
+    def __init__(self, directory: Optional[Path] = None):
+        self.dir = Path(directory) if directory else config_dir()
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.RLock()
+        self._db = sqlite3.connect(self.dir / "config.db",
+                                   check_same_thread=False)
+        self._db.executescript(_SCHEMA)
+        self._db.commit()
+
+    def close(self) -> None:
+        self._db.close()
+
+    # --- generic KV -------------------------------------------------------
+
+    def _get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT value FROM config WHERE key = ?", (key,)).fetchone()
+        return None if row is None else bytes(row[0])
+
+    def _set(self, key: str, value: Optional[bytes]) -> None:
+        with self._lock:
+            if value is None:
+                self._db.execute("DELETE FROM config WHERE key = ?", (key,))
+            else:
+                self._db.execute(
+                    "INSERT INTO config (key, value) VALUES (?, ?) "
+                    "ON CONFLICT(key) DO UPDATE SET value = excluded.value",
+                    (key, bytes(value)))
+            self._db.commit()
+
+    # --- identity (config/identity.rs:85-180) -----------------------------
+
+    def get_root_secret(self) -> Optional[bytes]:
+        return self._get("root_secret")
+
+    def set_root_secret(self, secret: bytes) -> None:
+        self._set("root_secret", secret)
+
+    def get_auth_token(self) -> Optional[bytes]:
+        return self._get("auth_token")
+
+    def set_auth_token(self, token: Optional[bytes]) -> None:
+        self._set("auth_token", token)
+
+    def get_obfuscation_key(self) -> Optional[bytes]:
+        return self._get("obfuscation_key")
+
+    def set_obfuscation_key(self, key: bytes) -> None:
+        if len(key) != 4:
+            raise ValueError("obfuscation key must be 4 bytes")
+        self._set("obfuscation_key", key)
+
+    def is_initialized(self) -> bool:
+        return self._get("initialized") == b"1"
+
+    def set_initialized(self) -> None:
+        self._set("initialized", b"1")
+
+    # --- backup config (config/backup.rs) ---------------------------------
+
+    def get_backup_path(self) -> Optional[str]:
+        v = self._get("backup_path")
+        return None if v is None else v.decode()
+
+    def set_backup_path(self, path: str) -> None:
+        self._set("backup_path", path.encode())
+
+    def get_highest_sent_index(self) -> int:
+        """Resume-safe index watermark (config/backup.rs:80-98)."""
+        v = self._get("highest_sent_index")
+        return -1 if v is None else int(v)
+
+    def set_highest_sent_index(self, idx: int) -> None:
+        self._set("highest_sent_index", str(int(idx)).encode())
+
+    def packfile_dir(self) -> Path:
+        d = data_dir() / "packfiles"
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def received_dir(self, peer_id: bytes) -> Path:
+        d = data_dir() / "received_packfiles" / bytes(peer_id).hex()
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def restore_dir(self) -> Path:
+        d = data_dir() / "restore_packfiles"
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    # --- peers ledger (config/peers.rs) ------------------------------------
+
+    def add_peer_negotiated(self, pubkey: bytes, amount: int,
+                            now: Optional[float] = None) -> None:
+        """Upsert-increment negotiated storage (peers.rs:110-123)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO peers (pubkey, bytes_negotiated, first_seen, last_seen)"
+                " VALUES (?, ?, ?, ?) ON CONFLICT(pubkey) DO UPDATE SET"
+                " bytes_negotiated = bytes_negotiated + excluded.bytes_negotiated,"
+                " last_seen = excluded.last_seen",
+                (bytes(pubkey), int(amount), now, now))
+            self._db.commit()
+
+    def add_peer_transmitted(self, pubkey: bytes, amount: int) -> None:
+        self._bump_peer(pubkey, "bytes_transmitted", amount)
+
+    def add_peer_received(self, pubkey: bytes, amount: int) -> None:
+        self._bump_peer(pubkey, "bytes_received", amount)
+
+    def _bump_peer(self, pubkey: bytes, column: str, amount: int) -> None:
+        now = time.time()
+        with self._lock:
+            cur = self._db.execute(
+                f"UPDATE peers SET {column} = {column} + ?, last_seen = ?"
+                " WHERE pubkey = ?", (int(amount), now, bytes(pubkey)))
+            if cur.rowcount == 0:
+                self._db.execute(
+                    f"INSERT INTO peers (pubkey, {column}, first_seen, last_seen)"
+                    " VALUES (?, ?, ?, ?)",
+                    (bytes(pubkey), int(amount), now, now))
+            self._db.commit()
+
+    def get_peer(self, pubkey: bytes) -> Optional[PeerInfo]:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT pubkey, bytes_transmitted, bytes_received,"
+                " bytes_negotiated, first_seen, last_seen FROM peers"
+                " WHERE pubkey = ?", (bytes(pubkey),)).fetchone()
+        return None if row is None else PeerInfo(bytes(row[0]), *row[1:])
+
+    def list_peers(self) -> list:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT pubkey, bytes_transmitted, bytes_received,"
+                " bytes_negotiated, first_seen, last_seen FROM peers").fetchall()
+        return [PeerInfo(bytes(r[0]), *r[1:]) for r in rows]
+
+    def find_peers_with_storage(self) -> list:
+        """Peers ordered by free (negotiated - transmitted) storage, most
+        first (peers.rs:176-193)."""
+        peers = [p for p in self.list_peers() if p.free_storage > 0]
+        peers.sort(key=lambda p: p.free_storage, reverse=True)
+        return peers
+
+    # --- event log (config/log.rs) -----------------------------------------
+
+    def add_event(self, event: str, data: dict,
+                  now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO log (timestamp, event, data) VALUES (?, ?, ?)",
+                (now, event, json.dumps(data, sort_keys=True)))
+            self._db.commit()
+
+    def last_event_time(self, event: str) -> Optional[float]:
+        """Rate-limiter query (log.rs:98-114)."""
+        with self._lock:
+            row = self._db.execute(
+                "SELECT MAX(timestamp) FROM log WHERE event = ?",
+                (event,)).fetchone()
+        return row[0]
+
+    def last_backup_size(self) -> Optional[int]:
+        """Size-estimate source (log.rs:132-160)."""
+        with self._lock:
+            row = self._db.execute(
+                "SELECT data FROM log WHERE event = ? ORDER BY id DESC LIMIT 1",
+                (EVENT_BACKUP,)).fetchone()
+        if row is None:
+            return None
+        return json.loads(row[0]).get("size")

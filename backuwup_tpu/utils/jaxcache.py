@@ -1,0 +1,23 @@
+"""Opt-in persistent XLA compilation cache.
+
+The dedup-pipeline programs (CDC scan, batched BLAKE3) are large unrolled
+graphs; first compilation is expensive (remote-compiled on the hardware
+path).  A persistent cache makes every process after the first start warm.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+_DEFAULT = Path(os.environ.get("BACKUWUP_JAX_CACHE",
+                               Path.home() / ".cache" / "backuwup_tpu_jax"))
+
+
+def enable_compilation_cache(path: Path = _DEFAULT) -> None:
+    import jax
+
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -20,6 +20,13 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the blake3/CDC programs are large unrolled
+# graphs; caching compiled executables across pytest runs keeps the suite
+# fast after the first run.
+from backuwup_tpu.utils.jaxcache import enable_compilation_cache
+
+enable_compilation_cache()
+
 import random
 
 import numpy as np

@@ -67,3 +67,24 @@ def test_sharded_scan_matches_oracle():
         data = _data(n, seed=n or 2)
         assert (chunk_stream_sharded(data, mesh, SMALL)
                 == cdc_cpu.chunk_stream(data, SMALL))
+
+
+def test_segment_overflow_falls_back_to_oracle(monkeypatch):
+    # Force the sparse-word capacity below the real candidate count so the
+    # oracle-rescan branch runs; output must stay bit-identical.
+    data = _data(200_000, seed=11)
+    scanner = TpuCdcScanner(SMALL, segment_size=65536)
+    monkeypatch.setattr(scanner, "_k_cap", lambda padded: 512)
+    n_cand = len(cdc_cpu.candidate_positions(data[:65536], SMALL)[1])
+    assert n_cand > 0  # sanity: there are candidates to overflow with
+    assert scanner.chunk_stream(data) == cdc_cpu.chunk_stream(data, SMALL)
+
+
+def test_sharded_overflow_falls_back_to_oracle():
+    devs = jax.devices()
+    mesh = jax.sharding.Mesh(np.array(devs), ("data",))
+    dense = CDCParams(min_size=64, desired_size=256, max_size=1024,
+                      mask_s_bits=6, mask_l_bits=4)
+    data = _data(300_000, seed=13)
+    got = chunk_stream_sharded(data, mesh, dense, k_cap=512)
+    assert got == cdc_cpu.chunk_stream(data, dense)

@@ -1,0 +1,71 @@
+"""Key derivation determinism, signatures, recovery-phrase round trip."""
+
+import pytest
+
+from backuwup_tpu.crypto import (
+    KeyManager,
+    hkdf_derive,
+    phrase_to_secret,
+    secret_to_phrase,
+    verify_signature,
+)
+
+
+def test_deterministic_derivation():
+    secret = bytes(range(32))
+    a = KeyManager.from_secret(secret)
+    b = KeyManager.from_secret(secret)
+    assert a.client_id == b.client_id
+    assert a.backup_secret == b.backup_secret
+    assert len(a.client_id) == 32 and len(a.backup_secret) == 32
+    # identity and backup key material must differ
+    assert a.backup_secret != secret
+
+
+def test_distinct_secrets_distinct_identities():
+    a = KeyManager.from_secret(b"\x01" * 32)
+    b = KeyManager.from_secret(b"\x02" * 32)
+    assert a.client_id != b.client_id
+
+
+def test_sign_verify():
+    km = KeyManager.from_secret(bytes(range(32)))
+    msg = b"storage request 12345"
+    sig = km.sign(msg)
+    assert verify_signature(km.client_id, msg, sig)
+    assert not verify_signature(km.client_id, msg + b"x", sig)
+    other = KeyManager.from_secret(b"\x05" * 32)
+    assert not verify_signature(other.client_id, msg, sig)
+
+
+def test_derive_backup_key_contexts():
+    km = KeyManager.from_secret(bytes(range(32)))
+    header = km.derive_backup_key(b"header")
+    index = km.derive_backup_key(b"index")
+    blob = km.derive_backup_key(b"\xaa" * 32)
+    assert len({header, index, blob}) == 3
+    assert km.derive_backup_key(b"header") == header
+    assert hkdf_derive(km.backup_secret, b"header") == header
+
+
+def test_phrase_round_trip():
+    secret = bytes(range(32))
+    phrase = secret_to_phrase(secret)
+    assert phrase_to_secret(phrase) == secret
+    # forgiveness: case and confusable characters
+    assert phrase_to_secret(phrase.upper().replace("1", "l")) == secret
+
+
+def test_phrase_rejects_typos():
+    phrase = secret_to_phrase(bytes(range(32)))
+    corrupted = ("7" if phrase[0] != "7" else "8") + phrase[1:]
+    with pytest.raises(ValueError):
+        phrase_to_secret(corrupted)
+    with pytest.raises(ValueError):
+        phrase_to_secret(phrase[:-9])
+
+
+def test_generate_restores_from_phrase():
+    km = KeyManager.generate()
+    restored = KeyManager.from_secret(phrase_to_secret(secret_to_phrase(km.root_secret)))
+    assert restored.client_id == km.client_id

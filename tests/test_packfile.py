@@ -1,0 +1,150 @@
+"""Packfile + blob index: round trips, format invariants, persistence."""
+
+import os
+
+import pytest
+
+from backuwup_tpu import defaults
+from backuwup_tpu.crypto import KeyManager
+from backuwup_tpu.ops.blake3_cpu import blake3_hash
+from backuwup_tpu.snapshot.blob_index import BlobIndex
+from backuwup_tpu.snapshot.packfile import (
+    BlobNotFoundError,
+    DirtyPackfileError,
+    PackfileReader,
+    PackfileWriter,
+    packfile_path,
+)
+from backuwup_tpu.wire import Blob, BlobKind
+
+KEYS = KeyManager.from_secret(bytes(range(32)))
+
+
+def _blob(data: bytes, kind=BlobKind.FILE_CHUNK) -> Blob:
+    return Blob(hash=blake3_hash(data), kind=kind, data=data)
+
+
+@pytest.fixture
+def writer_env(tmp_path):
+    written = []
+    w = PackfileWriter(KEYS, tmp_path / "pack",
+                       on_packfile=lambda pid, path, hashes, size:
+                       written.append((pid, path, hashes, size)))
+    return w, written, tmp_path
+
+
+def test_round_trip_single_packfile(writer_env, nprng):
+    w, written, tmp = writer_env
+    blobs = [_blob(nprng.integers(0, 256, n, dtype="u1").tobytes())
+             for n in (10, 1000, 65536)]
+    blobs.append(_blob(b"tree bytes", BlobKind.TREE))
+    for b in blobs:
+        w.add_blob(b)
+    w.flush()
+    w.close()
+    assert len(written) == 1
+    pid, path, hashes, size = written[0]
+    assert path == packfile_path(tmp / "pack", pid)
+    assert hashes == [b.hash for b in blobs]
+    reader = PackfileReader(KEYS, tmp / "pack")
+    for b in blobs:
+        got = reader.get_blob(pid, b.hash)
+        assert got.data == b.data and got.kind == b.kind
+    with pytest.raises(BlobNotFoundError):
+        reader.get_blob(pid, b"\x00" * 32)
+
+
+def test_write_triggers_at_target_size(writer_env, nprng):
+    w, written, _ = writer_env
+    # incompressible data: each 1 MiB blob stays ~1 MiB compressed
+    for _ in range(7):
+        w.add_blob(_blob(nprng.integers(0, 256, 1 << 20, dtype="u1").tobytes()))
+    assert len(written) >= 2  # 3 MiB target -> multiple files
+    w.flush()
+    for _, path, _, size in written:
+        assert size <= defaults.PACKFILE_MAX_SIZE
+
+
+def test_dirty_close_raises(writer_env):
+    w, _, _ = writer_env
+    w.add_blob(_blob(b"data"))
+    with pytest.raises(DirtyPackfileError):
+        w.close()
+    w.flush()
+    w.close()
+
+
+def test_encrypted_at_rest(writer_env):
+    w, written, tmp = writer_env
+    secret = b"super secret plaintext payload" * 10
+    w.add_blob(_blob(secret))
+    w.flush()
+    raw = written[0][1].read_bytes()
+    assert secret not in raw
+    # wrong key cannot read
+    other = PackfileReader(KeyManager.from_secret(b"\x09" * 32), tmp / "pack")
+    with pytest.raises(Exception):
+        other.get_blob(written[0][0], written[0][2][0])
+
+
+def test_blob_index_dedup_and_persistence(tmp_path):
+    idx = BlobIndex(KEYS, tmp_path / "index")
+    h1, h2 = blake3_hash(b"one"), blake3_hash(b"two")
+    pid = os.urandom(12)
+    assert not idx.is_duplicate(h1)
+    idx.mark_queued(h1)
+    assert idx.is_duplicate(h1)  # queued counts as duplicate
+    idx.finalize_packfile(pid, [h1, h2])
+    assert idx.lookup(h2) == pid
+    files = idx.flush()
+    assert len(files) == 1
+    # reload from disk
+    idx2 = BlobIndex(KEYS, tmp_path / "index")
+    assert idx2.load() == 2
+    assert idx2.lookup(h1) == pid
+    assert idx2.is_duplicate(h2)
+    # wrong key fails to decrypt
+    bad = BlobIndex(KeyManager.from_secret(b"\x08" * 32), tmp_path / "index")
+    with pytest.raises(Exception):
+        bad.load()
+
+
+def test_blob_index_split_files(tmp_path, monkeypatch):
+    monkeypatch.setattr(defaults, "INDEX_FILE_MAX_ENTRIES", 3)
+    idx = BlobIndex(KEYS, tmp_path / "index")
+    hashes = [blake3_hash(bytes([i])) for i in range(8)]
+    idx.finalize_packfile(os.urandom(12), hashes)
+    files = idx.flush()
+    assert [f.name for f in files] == ["000000", "000001", "000002"]
+    idx2 = BlobIndex(KEYS, tmp_path / "index")
+    assert idx2.load() == 8
+
+
+def test_rebuild_from_packfiles(tmp_path, nprng):
+    w = PackfileWriter(KEYS, tmp_path / "pack")
+    blobs = [_blob(nprng.integers(0, 256, 500, dtype="u1").tobytes())
+             for _ in range(5)]
+    for b in blobs:
+        w.add_blob(b)
+    w.flush()
+    reader = PackfileReader(KEYS, tmp_path / "pack")
+    idx = BlobIndex(KEYS, tmp_path / "index")
+    assert idx.rebuild_from_packfiles(reader, tmp_path / "pack") == 5
+    for b in blobs:
+        assert idx.is_duplicate(b.hash)
+        assert reader.get_blob(idx.lookup(b.hash), b.hash).data == b.data
+
+
+def test_index_never_reuses_file_counters(tmp_path):
+    """Counter doubles as the AES-GCM nonce: recovery paths that skip load()
+    must still advance past existing files."""
+    idx = BlobIndex(KEYS, tmp_path / "index")
+    idx.finalize_packfile(os.urandom(12), [blake3_hash(b"x")])
+    first = idx.flush()[0]
+    original = first.read_bytes()
+    # fresh instance, no load() (e.g. rebuild_from_packfiles recovery path)
+    idx2 = BlobIndex(KEYS, tmp_path / "index")
+    idx2.finalize_packfile(os.urandom(12), [blake3_hash(b"y")])
+    files = idx2.flush()
+    assert files[0].name == "000001"  # not 000000 again
+    assert first.read_bytes() == original
