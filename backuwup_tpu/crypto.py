@@ -115,7 +115,7 @@ def _checksum(secret: bytes) -> str:
 
 
 def secret_to_phrase(secret: bytes) -> str:
-    """32-byte secret -> 13 dash-separated groups (52 data + 4 check chars)."""
+    """32-byte secret -> 7 dash-separated groups (52 data + 4 check chars)."""
     if len(secret) != ROOT_SECRET_LEN:
         raise ValueError("root secret must be 32 bytes")
     v = int.from_bytes(secret, "big")

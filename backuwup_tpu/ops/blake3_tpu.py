@@ -110,10 +110,15 @@ def digest_padded(buf: jnp.ndarray, lens: jnp.ndarray, *, L: int) -> jnp.ndarray
     ever finalize the root.
     """
     B = buf.shape[0]
+    # tolerate junk beyond each row's true length (e.g. buffers gathered
+    # from a resident stream): BLAKE3 pads partial blocks with zeros
+    lens = lens.astype(jnp.int32)
+    buf = jnp.where(
+        jnp.arange(buf.shape[1], dtype=jnp.int32)[None, :] < lens[:, None],
+        buf, jnp.uint8(0))
     words = _bytes_to_words(buf.reshape(B, L, MAX_LEAVES_PER_CHUNK, BLOCK_LEN))
     lanes = B * L
     words_flat = words.reshape(lanes, MAX_LEAVES_PER_CHUNK, 16)
-    lens = lens.astype(jnp.int32)
     n_chunks = jnp.maximum(1, -(-lens // CHUNK_LEN))  # (B,)
     chunk_idx = jnp.arange(L, dtype=jnp.int32)
     chunk_bytes = jnp.clip(lens[:, None] - chunk_idx[None, :] * CHUNK_LEN,

@@ -1,0 +1,212 @@
+"""Sharded dedup-index probe: the global blob-hash table in TPU HBM.
+
+The reference's dedup authority is a host-memory sorted vector with binary
+search (``blob_index.rs:143-148``) — one lookup at a time.  Configs #4-#5 of
+``BASELINE.json`` lift it to the device: an open-addressed hash table whose
+slots live in HBM, **sharded across the mesh by hash**, probed for whole
+batches of fingerprints at once with the routing done by XLA collectives
+over ICI:
+
+* Each blob hash (BLAKE3, 32 bytes) is reduced to four u32 words; the table
+  stores 128-bit keys + a 32-bit value (packfile slot).  Keys being BLAKE3
+  output, slot indices and shard routing can use hash words directly — no
+  second hash function needed.
+* A query batch sharded ``P('data')`` is ``all_gather``-ed along the axis;
+  each device linearly probes only the queries whose owner shard is itself
+  and contributes masked results combined with ``psum`` — queries ride ICI,
+  table rows never move.
+* Inserts are functional: ``insert`` returns the next table state (XLA
+  donates the buffer, so the update is in place on device).  Linear probing
+  is a ``fori_loop`` over MAX_PROBES with vectorized gathers.
+* Batch-internal duplicates are pre-deduplicated host-side by the caller
+  (the snapshot packer already serializes per-batch inserts); device insert
+  handles cross-batch dedup against the resident table.
+
+CPU/TPU equivalence: :class:`backuwup_tpu.snapshot.blob_index.BlobIndex` is
+the reference semantics; tests assert identical found/new classification.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .. import defaults
+
+KEY_WORDS = 4  # 128-bit stored fingerprint of the 256-bit blake3 hash
+
+
+def hashes_to_queries(hashes) -> np.ndarray:
+    """List of 32-byte digests -> (N, 4) u32 query words (first 16 bytes)."""
+    if len(hashes) == 0:
+        return np.zeros((0, KEY_WORDS), dtype=np.uint32)
+    buf = np.frombuffer(b"".join(bytes(h)[:16] for h in hashes),
+                        dtype="<u4").reshape(-1, KEY_WORDS)
+    return np.ascontiguousarray(buf)
+
+
+@dataclass
+class ShardedDedupIndex:
+    """Functional sharded hash table; state lives on the mesh."""
+
+    mesh: Mesh
+    axis: str
+    capacity: int  # slots per shard
+    keys: jax.Array  # (D, capacity, KEY_WORDS) u32, 0-key = empty
+    values: jax.Array  # (D, capacity) u32
+    max_probes: int
+
+    @classmethod
+    def create(cls, mesh: Mesh, axis: str = "data",
+               capacity: int = defaults.DEDUP_SHARD_CAPACITY,
+               max_probes: int = defaults.DEDUP_MAX_PROBES):
+        d = mesh.shape[axis]
+        sharding = NamedSharding(mesh, P(axis))
+        keys = jax.device_put(
+            jnp.zeros((d, capacity, KEY_WORDS), dtype=jnp.uint32), sharding)
+        values = jax.device_put(
+            jnp.zeros((d, capacity), dtype=jnp.uint32), sharding)
+        return cls(mesh=mesh, axis=axis, capacity=capacity, keys=keys,
+                   values=values, max_probes=max_probes)
+
+    # --- device kernels ----------------------------------------------------
+
+    def _fn(self, insert: bool):
+        return _build_probe_fn(self.mesh, self.axis, self.capacity,
+                               self.max_probes, insert)
+
+    def probe(self, queries: np.ndarray) -> np.ndarray:
+        """found[i] = value+1 if present else 0 (u32)."""
+        q, n = _pad_queries(queries, self.mesh.shape[self.axis])
+        found = self._fn(False)(self.keys, self.values, q)
+        return np.asarray(found).reshape(-1)[:n]
+
+    def insert(self, queries: np.ndarray, values: np.ndarray) -> np.ndarray:
+        """Insert new keys (found keys keep their value); returns the same
+        found-vector as probe (pre-insert state).
+
+        Distinct new keys racing for one empty slot within a batch are
+        detected on device and retried here, so a returned 0 ("new") always
+        ends with the key resident."""
+        queries = np.asarray(queries, dtype=np.uint32).reshape(-1, KEY_WORDS)
+        values = np.asarray(values, dtype=np.uint32).reshape(-1)
+        out = np.zeros(queries.shape[0], dtype=np.uint32)
+        pending = np.arange(queries.shape[0])
+        first = True
+        while pending.size:
+            found, lost = self._insert_once(queries[pending], values[pending])
+            if first:
+                out[pending] = found
+                first = False
+            pending = pending[np.asarray(lost).astype(bool)]
+        return out
+
+    def _insert_once(self, queries: np.ndarray, values: np.ndarray):
+        d = self.mesh.shape[self.axis]
+        q, n = _pad_queries(queries, d)
+        v = np.zeros(q.shape[0] * q.shape[1], dtype=np.uint32)
+        v[:n] = values
+        v = jax.device_put(jnp.asarray(v.reshape(d, -1)),
+                           NamedSharding(self.mesh, P(self.axis)))
+        self.keys, self.values, found, lost = self._fn(True)(
+            self.keys, self.values, q, v)
+        return (np.asarray(found).reshape(-1)[:n],
+                np.asarray(lost).reshape(-1)[:n])
+
+
+def _pad_queries(queries: np.ndarray, d: int):
+    queries = np.asarray(queries, dtype=np.uint32).reshape(-1, KEY_WORDS)
+    n = queries.shape[0]
+    padded = max(d, -(-n // d) * d)
+    q = np.zeros((padded, KEY_WORDS), dtype=np.uint32)
+    q[:n] = queries
+    return q.reshape(d, -1, KEY_WORDS), n
+
+
+@functools.lru_cache(maxsize=64)
+def _build_probe_fn(mesh: Mesh, axis: str, capacity: int, max_probes: int,
+                    insert: bool):
+    """Compile the shard_map probe/insert program for one mesh config."""
+    n_dev = mesh.shape[axis]
+
+    def local_probe(keys, values, q):
+        """Probe the local shard for queries q (N, 4); returns
+        (found (N,), slot (N,), empty_slot_found (N,))."""
+        n = q.shape[0]
+        start = (q[:, 1] % jnp.uint32(capacity)).astype(jnp.int32)
+        is_empty_q = jnp.all(q == 0, axis=1)
+
+        def body(p, carry):
+            done, found, slot = carry
+            idx = (start + p) % capacity
+            k = keys[idx]  # (N, 4) gather
+            hit = jnp.all(k == q, axis=1)
+            empty = jnp.all(k == 0, axis=1)
+            # first terminal event wins: hit -> found; empty -> insert here
+            newly = ~done & (hit | empty)
+            found = jnp.where(newly & hit, values[idx] + 1, found)
+            slot = jnp.where(newly, idx, slot)
+            done = done | hit | empty
+            return done, found, slot
+
+        done0 = is_empty_q  # padding queries probe nothing
+        # derive loop-carry inits from q so they share its vma under shard_map
+        found0 = q[:, 0] * jnp.uint32(0)
+        slot0 = found0.astype(jnp.int32) - 1
+        done, found, slot = jax.lax.fori_loop(0, max_probes, body,
+                                              (done0, found0, slot0))
+        return found, slot, done
+
+    def shard_fn(keys, values, q, *ins_vals):
+        # keys/values: local shard (1, capacity, 4)/(1, capacity)
+        # q: local query slice (1, Q/D, 4)
+        keys = keys[0]
+        values = values[0]
+        me = jax.lax.axis_index(axis)
+        # queries ride ICI to every shard; table rows never move
+        allq = jax.lax.all_gather(q[0], axis).reshape(-1, KEY_WORDS)  # (Q, 4)
+        owner = (allq[:, 0] % jnp.uint32(n_dev)).astype(jnp.int32)
+        mine = owner == me
+        # non-owned queries become empty (probe nothing, contribute 0)
+        q_masked = jnp.where(mine[:, None], allq, jnp.uint32(0))
+        found, slot, _ = local_probe(keys, values, q_masked)
+        found = jnp.where(mine, found, jnp.uint32(0))
+        if insert:
+            allv = jax.lax.all_gather(ins_vals[0][0], axis).reshape(-1)
+            is_new = (mine & (found == 0) & (slot >= 0)
+                      & ~jnp.all(allq == 0, axis=1))
+            # Scatter new keys into the local shard.  Two *different* new
+            # keys landing on the same empty slot in one batch: last write
+            # wins.  The scatter is verified below and losers are reported
+            # so the host retries them (they then probe past this slot).
+            tgt = jnp.where(is_new, slot, capacity)  # capacity = dropped
+            upd_keys = keys.at[tgt].set(
+                jnp.where(is_new[:, None], allq, jnp.uint32(0)), mode="drop")
+            upd_vals = values.at[tgt].set(
+                jnp.where(is_new, allv, jnp.uint32(0)), mode="drop")
+            stored_key = upd_keys[jnp.clip(slot, 0, capacity - 1)]
+            lost = (is_new & ~jnp.all(stored_key == allq, axis=1)
+                    ).astype(jnp.uint32)
+            found_all = jax.lax.psum(found, axis)
+            lost_all = jax.lax.psum(lost, axis)
+            myq = found_all.reshape(n_dev, -1)[me]
+            mylost = lost_all.reshape(n_dev, -1)[me]
+            return upd_keys[None], upd_vals[None], myq[None], mylost[None]
+        found_all = jax.lax.psum(found, axis)
+        myq = found_all.reshape(n_dev, -1)[me]
+        return myq[None]
+
+    in_specs = [P(axis), P(axis), P(axis)] + ([P(axis)] if insert else [])
+    out_specs = (P(axis), P(axis), P(axis), P(axis)) if insert else P(axis)
+    mapped = jax.shard_map(shard_fn, mesh=mesh, in_specs=tuple(in_specs),
+                           out_specs=out_specs)
+    if insert:
+        return jax.jit(mapped, donate_argnums=(0, 1))
+    return jax.jit(mapped)

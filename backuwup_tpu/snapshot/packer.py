@@ -192,13 +192,15 @@ class DirPacker:
             subdirs = [p for p in entries if p.is_dir() and not p.is_symlink()]
             children = [h for h in self._pack_files(files) if h is not None]
             children.extend(dir_hash[s] for s in subdirs if s in dir_hash)
-            st = d.stat()
+            try:
+                st = d.stat()
+                meta = TreeMetadata(size=0, mtime_ns=st.st_mtime_ns,
+                                    ctime_ns=st.st_ctime_ns)
+            except OSError:  # directory vanished mid-walk: keep its children
+                meta = TreeMetadata()
             name = "" if d == root else d.name
-            dir_hash[d] = self._tree_with_split(
-                TreeKind.DIR, name,
-                TreeMetadata(size=0, mtime_ns=st.st_mtime_ns,
-                             ctime_ns=st.st_ctime_ns),
-                children)
+            dir_hash[d] = self._tree_with_split(TreeKind.DIR, name, meta,
+                                                children)
             self.stats.dirs += 1
         self.writer.flush()
         return dir_hash[root]

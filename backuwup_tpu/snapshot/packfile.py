@@ -103,6 +103,11 @@ class PackfileWriter:
     file lands on disk — the seam the send pipeline and blob index hang off.
     """
 
+    # encoded header entry: hash(32) + kind(4) + compression(4) + length(8)
+    # + offset(8); file layout: len(8) + AESGCM tag(16) + count field(8)
+    _HEADER_ENTRY = 56
+    _FILE_OVERHEAD = 8 + 16 + 8
+
     def __init__(self, keys: KeyManager, out_dir: Path,
                  on_packfile: Optional[Callable] = None):
         self.keys = keys
@@ -110,8 +115,12 @@ class PackfileWriter:
         self.on_packfile = on_packfile
         self._pending: List[_Pending] = []
         self._pending_plain = 0
+        self._pending_ct = 0
         self._header_key = keys.derive_backup_key(HEADER_KEY_INFO)
         self.bytes_written = 0
+
+    def _file_size(self, n_blobs: int, ct_bytes: int) -> int:
+        return self._FILE_OVERHEAD + n_blobs * self._HEADER_ENTRY + ct_bytes
 
     @property
     def pending_blobs(self) -> int:
@@ -128,13 +137,21 @@ class PackfileWriter:
         nonce = os.urandom(NONCE_LEN)
         ct = AESGCM(key).encrypt(nonce, comp, None)
         record = nonce + ct
-        if len(record) + NONCE_LEN > defaults.PACKFILE_MAX_SIZE:
+        if self._file_size(1, len(record)) > defaults.PACKFILE_MAX_SIZE:
             raise PackfileError("single blob exceeds packfile max size")
+        # hard cap is enforced *before* anything hits disk: flush the current
+        # batch if this blob would push the file over PACKFILE_MAX_SIZE
+        if self._pending and (
+                self._file_size(len(self._pending) + 1,
+                                self._pending_ct + len(record))
+                > defaults.PACKFILE_MAX_SIZE):
+            self._write_packfile()
         header = PackfileHeaderBlob(
             hash=blob.hash, kind=blob.kind, compression=comp_kind,
             length=len(record), offset=0)  # offset assigned at write time
         self._pending.append(_Pending(header, record, len(blob.data)))
         self._pending_plain += len(blob.data)
+        self._pending_ct += len(record)
         if (self._pending_plain >= defaults.PACKFILE_TARGET_SIZE
                 or len(self._pending) >= defaults.PACKFILE_MAX_BLOBS):
             self._write_packfile()
@@ -173,12 +190,12 @@ class PackfileWriter:
                 f.write(p.record)
         os.replace(tmp, path)
         size = path.stat().st_size
-        if size > defaults.PACKFILE_MAX_SIZE:
-            raise PackfileError("packfile exceeded hard cap — policy bug")
         self.bytes_written += size
         hashes = [h.hash for h in headers]
         self._pending = []
         self._pending_plain = 0
+        self._pending_ct = 0
+        assert size <= defaults.PACKFILE_MAX_SIZE, "cap enforced in add_blob"
         if self.on_packfile is not None:
             self.on_packfile(packfile_id, path, hashes, size)
 

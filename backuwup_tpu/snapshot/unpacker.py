@@ -9,6 +9,7 @@ restored (``:95-101``).
 from __future__ import annotations
 
 import os
+from collections import deque
 from pathlib import Path
 from typing import Callable, List, Optional
 
@@ -66,10 +67,10 @@ class DirUnpacker:
         root = fetch_full_tree(self.resolve, snapshot_hash)
         if root.kind != TreeKind.DIR:
             raise RestoreError("snapshot root is not a directory tree")
-        queue = [(root, dest)]
+        queue = deque([(root, dest)])
         dir_times = []
         while queue:
-            tree, path = queue.pop(0)
+            tree, path = queue.popleft()
             path.mkdir(parents=True, exist_ok=True)
             if tree.metadata.mtime_ns:
                 dir_times.append((path, tree.metadata.mtime_ns))

@@ -180,15 +180,7 @@ class Store:
     def add_peer_negotiated(self, pubkey: bytes, amount: int,
                             now: Optional[float] = None) -> None:
         """Upsert-increment negotiated storage (peers.rs:110-123)."""
-        now = time.time() if now is None else now
-        with self._lock:
-            self._db.execute(
-                "INSERT INTO peers (pubkey, bytes_negotiated, first_seen, last_seen)"
-                " VALUES (?, ?, ?, ?) ON CONFLICT(pubkey) DO UPDATE SET"
-                " bytes_negotiated = bytes_negotiated + excluded.bytes_negotiated,"
-                " last_seen = excluded.last_seen",
-                (bytes(pubkey), int(amount), now, now))
-            self._db.commit()
+        self._bump_peer(pubkey, "bytes_negotiated", amount, now)
 
     def add_peer_transmitted(self, pubkey: bytes, amount: int) -> None:
         self._bump_peer(pubkey, "bytes_transmitted", amount)
@@ -196,8 +188,9 @@ class Store:
     def add_peer_received(self, pubkey: bytes, amount: int) -> None:
         self._bump_peer(pubkey, "bytes_received", amount)
 
-    def _bump_peer(self, pubkey: bytes, column: str, amount: int) -> None:
-        now = time.time()
+    def _bump_peer(self, pubkey: bytes, column: str, amount: int,
+                   now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
         with self._lock:
             cur = self._db.execute(
                 f"UPDATE peers SET {column} = {column} + ?, last_seen = ?"

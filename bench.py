@@ -1,0 +1,122 @@
+"""Benchmark: device-resident chunk+hash throughput vs single-thread CPU.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "MiB/s", "vs_baseline": N}
+
+Method (BASELINE.json north star — chunk + fingerprint MiB/s at identical
+dedup output):
+
+* TPU path: corpus segments are synthesized **on device** with the JAX PRNG
+  (the dev rig's host<->device relay tunnel is ~6 MiB/s, three orders below
+  real PCIe/DMA, so streaming host bytes would measure the tunnel, not the
+  kernels).  Each segment runs the full resident pipeline: gear scan ->
+  sparse candidates -> host cut selection -> on-device chunk gather ->
+  batched BLAKE3.
+* CPU baseline: the same pipeline on one host thread (numpy oracle:
+  vectorized gear scan + batched BLAKE3 engine) over host-synthesized
+  segments of the same size/distribution.
+* Parity gate: an 8 MiB corpus is pushed through BOTH paths bit-for-bit;
+  chunk boundaries and digests must match exactly or the benchmark reports
+  failure — speed without identical dedup output is meaningless.
+
+Environment knobs: BENCH_SEGMENTS (default 4), BENCH_SEGMENT_MIB (default
+128), BENCH_CPU_MIB (default 64).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    from backuwup_tpu.utils.jaxcache import enable_compilation_cache
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from backuwup_tpu.ops import cdc_cpu
+    from backuwup_tpu.ops.blake3_cpu import Blake3Numpy
+    from backuwup_tpu.ops.gear import CDCParams
+    from backuwup_tpu.ops.pipeline import DevicePipeline
+
+    segments = int(os.environ.get("BENCH_SEGMENTS", "4"))
+    seg_mib = int(os.environ.get("BENCH_SEGMENT_MIB", "128"))
+    cpu_mib = int(os.environ.get("BENCH_CPU_MIB", "64"))
+    params = CDCParams()  # production 256KiB/1MiB/3MiB
+    pipeline = DevicePipeline(params)
+    seg_bytes = seg_mib * (1 << 20)
+
+    log(f"devices: {jax.devices()}")
+
+    # --- parity gate -------------------------------------------------------
+    rng = np.random.default_rng(1234)
+    parity = rng.integers(0, 256, 8 << 20, dtype=np.uint8)
+    # tile a block so dedup has real duplicates to find
+    parity[4 << 20:6 << 20] = parity[0:2 << 20]
+    parity_bytes = parity.tobytes()
+    cpu_chunks = cdc_cpu.chunk_stream(parity_bytes, params)
+    cpu_digests = Blake3Numpy().digest_batch(
+        [parity_bytes[o:o + l] for o, l in cpu_chunks])
+    dev_stream = jax.device_put(jnp.asarray(parity))
+    tpu_chunks, tpu_digests = pipeline.process_segment(
+        dev_stream, len(parity_bytes))
+    tpu_digest_bytes = [bytes(d) for d in tpu_digests]
+    if tpu_chunks != cpu_chunks or tpu_digest_bytes != cpu_digests:
+        print(json.dumps({"metric": "chunk+hash parity FAILED", "value": 0.0,
+                          "unit": "MiB/s", "vs_baseline": 0.0}))
+        return
+    dedup = len(set(cpu_digests)) / len(cpu_digests)
+    log(f"parity OK: {len(cpu_chunks)} chunks, unique-ratio {dedup:.3f}")
+
+    # --- TPU timing: device-synthesized resident segments ------------------
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def synth(key):
+        return jax.random.randint(key, (seg_bytes,), 0, 256, dtype=jnp.uint8)
+
+    # warm (compile everything once)
+    stream = synth(key)
+    pipeline.process_segment(stream, seg_bytes)
+
+    t0 = time.time()
+    total_chunks = 0
+    for i in range(segments):
+        key, sub = jax.random.split(key)
+        stream = synth(sub)
+        chunks, digests = pipeline.process_segment(stream, seg_bytes)
+        total_chunks += len(chunks)
+    tpu_s = time.time() - t0
+    tpu_mibs = segments * seg_mib / tpu_s
+    log(f"tpu: {segments}x{seg_mib} MiB in {tpu_s:.2f}s = {tpu_mibs:.1f} MiB/s"
+        f" ({total_chunks} chunks)")
+
+    # --- CPU baseline: single thread, same pipeline ------------------------
+    host = rng.integers(0, 256, cpu_mib << 20, dtype=np.uint8).tobytes()
+    engine = Blake3Numpy()
+    t0 = time.time()
+    chunks = cdc_cpu.chunk_stream(host, params)
+    engine.digest_batch([host[o:o + l] for o, l in chunks])
+    cpu_s = time.time() - t0
+    cpu_mibs = cpu_mib / cpu_s
+    log(f"cpu: {cpu_mib} MiB in {cpu_s:.2f}s = {cpu_mibs:.1f} MiB/s")
+
+    print(json.dumps({
+        "metric": "dedup pipeline chunk+hash throughput (device-resident)",
+        "value": round(tpu_mibs, 2),
+        "unit": "MiB/s",
+        "vs_baseline": round(tpu_mibs / cpu_mibs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

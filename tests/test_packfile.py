@@ -148,3 +148,18 @@ def test_index_never_reuses_file_counters(tmp_path):
     files = idx2.flush()
     assert files[0].name == "000001"  # not 000000 again
     assert first.read_bytes() == original
+
+
+def test_hard_cap_enforced_before_write(writer_env, nprng):
+    """A near-max blob after buffered data must flush first, never produce
+    an oversized file."""
+    w, written, _ = writer_env
+    w.add_blob(_blob(nprng.integers(0, 256, 2 << 20, dtype="u1").tobytes()))
+    big = nprng.integers(0, 256, 14 << 20, dtype="u1").tobytes()
+    w.add_blob(_blob(big))
+    w.flush()
+    assert len(written) >= 2
+    for _, path, _, size in written:
+        assert size <= defaults.PACKFILE_MAX_SIZE
+    with pytest.raises(Exception):
+        w.add_blob(_blob(nprng.integers(0, 256, 17 << 20, dtype="u1").tobytes()))
