@@ -1,0 +1,115 @@
+"""Client application: wires identity, store, networking, and engine.
+
+The equivalent of the reference client's ``main()`` boot sequence
+(``client/src/main.rs:44-85``): load-or-create identity, register/login,
+start the push channel, install the P2P request handlers (store incoming
+peer data; serve restores), and expose backup/restore entry points.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from pathlib import Path
+from typing import Optional
+
+from . import wire
+from .crypto import KeyManager
+from .engine import Engine
+from .net.client import ServerClient
+from .net.p2p import P2PNode, ReceivedFilesWriter, Receiver
+from .ops.backend import ChunkerBackend
+from .store import Store
+from .ui.messenger import Messenger
+
+
+class ClientApp:
+    def __init__(self, config_dir: Optional[Path] = None,
+                 data_dir: Optional[Path] = None,
+                 server_addr: Optional[str] = None,
+                 backend: Optional[ChunkerBackend] = None,
+                 messenger: Optional[Messenger] = None):
+        self.store = Store(config_dir, data_base=data_dir)
+        self.messenger = messenger or Messenger()
+        secret = self.store.get_root_secret()
+        if secret is None:
+            self.keys = KeyManager.generate()
+            self.store.set_root_secret(self.keys.root_secret)
+            self.store.set_obfuscation_key(os.urandom(4))
+            self.fresh_identity = True
+        else:
+            self.keys = KeyManager.from_secret(secret)
+            self.fresh_identity = False
+        if self.store.get_obfuscation_key() is None:
+            self.store.set_obfuscation_key(os.urandom(4))
+        self.server = ServerClient(self.keys, self.store, addr=server_addr)
+        self.node = P2PNode(self.keys, self.store, self.server)
+        self.node.on_transport_request = self._accept_peer_data
+        self.node.on_restore_request = self._serve_restore
+        self.server.on_backup_matched = self._backup_matched
+        self.engine = Engine(self.keys, self.store, self.server, self.node,
+                             backend=backend, messenger=self.messenger)
+
+    @property
+    def client_id(self) -> bytes:
+        return self.keys.client_id
+
+    # --- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        """Register (first run) / login, then open the push channel."""
+        if not self.store.is_initialized():
+            await self.server.register()
+            self.store.set_initialized()
+        await self.server.login()
+        self.server.start_ws()
+        await asyncio.wait_for(self.server.ws_connected.wait(), 10)
+        self.messenger.log("connected to coordination server")
+
+    async def stop(self) -> None:
+        await self.server.close()
+        self.store.close()
+
+    # --- push handlers -----------------------------------------------------
+
+    async def _backup_matched(self, msg: wire.BackupMatched) -> None:
+        """Record the negotiated allowance for both roles
+        (send.rs:312-335)."""
+        self.store.add_peer_negotiated(msg.destination_id,
+                                       msg.storage_available)
+        self.messenger.log(
+            f"matched with {bytes(msg.destination_id).hex()[:8]} for "
+            f"{msg.storage_available} bytes")
+
+    async def _accept_peer_data(self, source: bytes, transport) -> None:
+        writer = ReceivedFilesWriter(self.store, source)
+        count = await Receiver(transport, writer.sink).run()
+        self.messenger.log(
+            f"stored {count} files for peer {bytes(source).hex()[:8]}")
+
+    async def _serve_restore(self, source: bytes, transport) -> None:
+        sent = await self.node.serve_restore(source, transport)
+        self.messenger.log(
+            f"served {sent} files back to {bytes(source).hex()[:8]}")
+
+    # --- commands (ws_dispatcher.rs:16-23) ---------------------------------
+
+    async def backup(self, root: Optional[Path] = None) -> bytes:
+        self.messenger.backup_started()
+        try:
+            snapshot = await self.engine.run_backup(root)
+            self.messenger.backup_finished(snapshot)
+            return snapshot
+        except Exception as e:
+            self.messenger.log(f"backup failed: {e}")
+            raise
+
+    async def restore(self, dest: Optional[Path] = None) -> Path:
+        self.messenger.restore_started()
+        try:
+            path = await self.engine.run_restore(dest)
+            self.messenger.restore_finished()
+            return path
+        except Exception as e:
+            self.messenger.log(f"restore failed: {e}")
+            raise

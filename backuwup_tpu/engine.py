@@ -1,0 +1,362 @@
+"""Backup/restore engine: orchestration of pack ∥ send ∥ progress.
+
+Re-designs ``client/src/backup/mod.rs`` + ``backup_orchestrator.rs`` +
+``send.rs`` on asyncio:
+
+* ``run_backup`` runs the packer (thread executor — chunking may drive the
+  device) **concurrently** with the send loop, coupled by pause/resume
+  backpressure on the local packfile buffer: packing pauses when unsent
+  packfiles exceed 100 MiB, resumes below 50 MiB free
+  (``defaults.rs:38,59``, ``backup_orchestrator.rs:81-113``).
+* The send loop acquires peers: reuse the active transport, else dial known
+  peers most-free-storage-first, else issue a storage request and wait for
+  a match (``send.rs:209-262``); request sizing is
+  ``estimate − fulfilled`` clamped to [50 MB step, 150 MB cap]
+  (``send.rs:359-369``).
+* Packfiles are deleted locally only after the peer's signed ack
+  (``send.rs:277-289``); encrypted index files follow once packing
+  completes, watermarked by ``highest_sent_index`` so re-runs resume
+  (``send.rs:135-176``, ``config/backup.rs:80-98``).
+* ``run_restore`` asks the server for the latest snapshot + negotiated
+  peers, pulls everything back over RESTORE_ALL transports, rebuilds the
+  blob index from the restored index files, and unpacks byte-identically
+  (``backup/mod.rs:130-192``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+from . import defaults, wire
+from .crypto import KeyManager
+from .net.client import ServerClient
+from .net.p2p import P2PError, P2PNode, Receiver, RestoreFilesWriter, Transport
+from .ops.backend import ChunkerBackend, select_backend
+from .snapshot.blob_index import BlobIndex, index_file_name
+from .snapshot.packer import DirPacker
+from .snapshot.packfile import PackfileReader, PackfileWriter, packfile_path
+from .store import EVENT_BACKUP, EVENT_RESTORE_REQUEST, Store
+
+
+class EngineError(Exception):
+    pass
+
+
+class Orchestrator:
+    """Cross-task shared state (backup_orchestrator.rs:20-45)."""
+
+    def __init__(self):
+        self.bytes_written = 0
+        self.bytes_sent = 0
+        self.packing_completed = False
+        self.failed = False
+        self._resume = threading.Event()
+        self._resume.set()
+        self.active_transports: Dict[bytes, Transport] = {}
+
+    # pause/resume (backup_orchestrator.rs:81-113)
+    def pause(self) -> None:
+        self._resume.clear()
+
+    def resume(self) -> None:
+        self._resume.set()
+
+    @property
+    def paused(self) -> bool:
+        return not self._resume.is_set()
+
+    def block_if_paused(self) -> None:
+        """Called from the packer thread between blobs
+        (block_if_paused! macro, backup/mod.rs:241-250)."""
+        self._resume.wait()
+
+
+class Engine:
+    def __init__(self, keys: KeyManager, store: Store, server: ServerClient,
+                 node: P2PNode, backend: Optional[ChunkerBackend] = None,
+                 messenger=None):
+        self.keys = keys
+        self.store = store
+        self.server = server
+        self.node = node
+        self.backend = backend or select_backend()
+        self.messenger = messenger
+        self.index = BlobIndex(keys, self._index_dir())
+        self.index.load()
+        self.orchestrator = Orchestrator()
+
+    # --- paths -------------------------------------------------------------
+
+    def _pack_dir(self) -> Path:
+        return self.store.packfile_dir()
+
+    def _index_dir(self) -> Path:
+        return self.store.index_dir()
+
+    def _log(self, msg: str) -> None:
+        if self.messenger is not None:
+            self.messenger.log(msg)
+
+    def _progress(self, **kw) -> None:
+        if self.messenger is not None:
+            self.messenger.progress(**kw)
+
+    # --- size estimate (backup/mod.rs:207-238) -----------------------------
+
+    def estimate_size(self, root: Path) -> int:
+        last = self.store.last_backup_size()
+        total = 0
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for f in filenames:
+                try:
+                    total += (Path(dirpath) / f).stat().st_size
+                except OSError:
+                    pass
+        if last is not None:
+            # incremental estimate: only the size delta needs new storage
+            return max(total - last, min(total, 50 * 1000 * 1000))
+        return total
+
+    # --- buffer accounting --------------------------------------------------
+
+    def _unsent_packfiles(self) -> list:
+        """(packfile_id, path, size) of every local packfile not yet sent."""
+        out = []
+        base = self._pack_dir()
+        if not base.is_dir():
+            return out
+        for shard in sorted(base.iterdir()):
+            if not shard.is_dir():
+                continue
+            for f in sorted(shard.iterdir()):
+                if f.suffix:  # .tmp
+                    continue
+                try:
+                    out.append((bytes.fromhex(f.name), f, f.stat().st_size))
+                except (ValueError, OSError):
+                    continue
+        return out
+
+    def _buffer_bytes(self) -> int:
+        return sum(s for _, _, s in self._unsent_packfiles())
+
+    # --- backup ------------------------------------------------------------
+
+    async def run_backup(self, root: Optional[Path] = None) -> bytes:
+        root = Path(root or (self.store.get_backup_path() or ""))
+        if not root.is_dir():
+            raise EngineError(f"backup path {root} is not a directory")
+        orch = self.orchestrator = Orchestrator()
+        estimate = self.estimate_size(root)
+        self._log(f"backup started, estimated {estimate} bytes")
+        loop = asyncio.get_running_loop()
+        snapshot_holder: dict = {}
+
+        def pack_thread() -> None:
+            writer = PackfileWriter(
+                self.keys, self._pack_dir(),
+                on_packfile=self._on_packfile_threadsafe(loop))
+            packer = DirPacker(self.backend, writer, self.index,
+                               progress=self._pack_progress,
+                               should_pause=orch.block_if_paused)
+            snapshot_holder["hash"] = packer.pack(root)
+            snapshot_holder["stats"] = packer.stats
+
+        pack_fut = loop.run_in_executor(None, pack_thread)
+        send_task = asyncio.create_task(self._send_loop(orch, estimate))
+        try:
+            await pack_fut
+        except Exception:
+            orch.failed = True
+            send_task.cancel()
+            raise
+        orch.packing_completed = True
+        self.index.flush()
+        try:
+            await send_task
+        except asyncio.CancelledError:
+            raise EngineError("send pipeline cancelled")
+        snapshot = snapshot_holder["hash"]
+        await self.server.backup_done(snapshot)
+        self.store.add_event(EVENT_BACKUP, {
+            "size": snapshot_holder["stats"].bytes_read,
+            "snapshot": snapshot.hex()})
+        self._log(f"backup finished: {snapshot.hex()}")
+        return snapshot
+
+    def _pack_progress(self, **kw) -> None:
+        self._progress(**kw)
+
+    def _on_packfile_threadsafe(self, loop):
+        def cb(pid, path, hashes, size):
+            self.index.finalize_packfile(pid, hashes)
+            self.orchestrator.bytes_written += size
+        return cb
+
+    # --- send pipeline (send.rs) -------------------------------------------
+
+    async def _send_loop(self, orch: Orchestrator, estimate: int) -> None:
+        fulfilled = 0
+        last_request = 0.0
+        while True:
+            buffer = self._buffer_bytes()
+            # backpressure (send.rs:52-54, 95-100)
+            if buffer > defaults.PACKFILE_LOCAL_BUFFER_LIMIT and not orch.paused:
+                orch.pause()
+                self._log("packing paused: local buffer full")
+            elif orch.paused and (defaults.PACKFILE_LOCAL_BUFFER_LIMIT - buffer
+                                  > defaults.PACKFILE_RESUME_THRESHOLD):
+                orch.resume()
+                self._log("packing resumed")
+            unsent = self._unsent_packfiles()
+            if not unsent:
+                if orch.packing_completed:
+                    break
+                await asyncio.sleep(0.05)
+                continue
+            transport, peer_id, peer_free = await self._get_peer_connection(
+                orch, estimate, fulfilled, last_request)
+            if transport is None:
+                last_request = time.time()
+                await asyncio.sleep(0.2)
+                continue
+            sent_any = False
+            for pid, path, size in unsent:
+                if size > peer_free + defaults.PEER_OVERUSE_GRACE // 2:
+                    break  # peer full: next loop acquires another peer
+                try:
+                    await transport.send_data(path.read_bytes(),
+                                              wire.FileInfoKind.PACKFILE, pid)
+                except P2PError:
+                    await self._drop_transport(orch, peer_id)
+                    break
+                path.unlink()  # delete only after ack (send.rs:277-289)
+                self.store.add_peer_transmitted(peer_id, size)
+                orch.bytes_sent += size
+                peer_free -= size
+                fulfilled += size
+                sent_any = True
+                self._progress(bytes_transmitted=orch.bytes_sent)
+            if not sent_any:
+                await self._drop_transport(orch, peer_id)
+                await asyncio.sleep(0.1)
+        # index files last, watermarked (send.rs:135-176)
+        await self._send_index_files(orch, estimate, fulfilled)
+
+    async def _send_index_files(self, orch, estimate, fulfilled) -> None:
+        watermark = self.store.get_highest_sent_index()
+        files = sorted(p for p in self._index_dir().iterdir()
+                       if p.name.isdigit() and int(p.name) > watermark)
+        if not files:
+            return
+        while True:
+            transport, peer_id, _free = await self._get_peer_connection(
+                orch, estimate, fulfilled, 0.0)
+            if transport is None:
+                await asyncio.sleep(0.2)
+                continue
+            try:
+                for f in files:
+                    num = int(f.name)
+                    await transport.send_data(
+                        f.read_bytes(), wire.FileInfoKind.INDEX,
+                        num.to_bytes(8, "little"))
+                    self.store.set_highest_sent_index(num)
+                    self.store.add_peer_transmitted(peer_id,
+                                                    f.stat().st_size)
+                return
+            except P2PError:
+                await self._drop_transport(orch, peer_id)
+
+    async def _get_peer_connection(self, orch, estimate, fulfilled,
+                                   last_request):
+        """(transport, peer_id, free) — reuse, dial known, or request
+        storage (send.rs:209-262)."""
+        for peer_id, t in list(orch.active_transports.items()):
+            peer = self.store.get_peer(peer_id)
+            free = peer.free_storage if peer else 0
+            if free > 0:
+                return t, peer_id, free
+            await self._drop_transport(orch, peer_id)
+        for peer in self.store.find_peers_with_storage():
+            try:
+                t = await self.node.connect(peer.pubkey,
+                                            wire.RequestType.TRANSPORT,
+                                            timeout=3.0)
+                orch.active_transports[peer.pubkey] = t
+                return t, peer.pubkey, peer.free_storage
+            except (P2PError, Exception):
+                continue
+        # no peer available: storage request, throttled (send.rs:296-309)
+        if time.time() - last_request >= defaults.STORAGE_REQUEST_RETRY_S or \
+                not last_request:
+            missing = max(estimate - fulfilled, 0)
+            amount = min(max(missing, defaults.STORAGE_REQUEST_STEP),
+                         defaults.STORAGE_REQUEST_CAP)
+            try:
+                await self.server.backup_storage_request(amount)
+            except Exception:
+                pass
+        return None, None, 0
+
+    async def _drop_transport(self, orch, peer_id) -> None:
+        t = orch.active_transports.pop(bytes(peer_id), None)
+        if t is not None:
+            await t.close()
+
+    # --- restore (backup/mod.rs:117-192) -----------------------------------
+
+    async def run_restore(self, dest: Optional[Path] = None) -> Path:
+        last = self.store.last_event_time(EVENT_RESTORE_REQUEST)
+        if last is not None and \
+                time.time() - last < defaults.RESTORE_REQUEST_THROTTLE_S:
+            raise EngineError("restore requested too recently")
+        self.store.add_event(EVENT_RESTORE_REQUEST, {})
+        info = await self.server.backup_restore()
+        if info.snapshot_hash is None:
+            raise EngineError("no snapshot recorded on server")
+        peers = [bytes.fromhex(p) for p in info.peers]
+        if not peers:
+            raise EngineError("no peers hold our data")
+        writer = RestoreFilesWriter(self.store)
+        got_any = False
+        for peer_id in peers:
+            try:
+                t = await self.node.connect(peer_id,
+                                            wire.RequestType.RESTORE_ALL,
+                                            timeout=10.0)
+                await Receiver(t, writer.sink).run()
+                await t.close()
+                got_any = True
+            except P2PError as e:
+                self._log(f"restore from {peer_id.hex()[:8]} failed: {e}")
+        if not got_any:
+            raise EngineError("no peer served our restore")
+        return self._unpack_restored(info.snapshot_hash, dest)
+
+    def _unpack_restored(self, snapshot_hash: bytes,
+                         dest: Optional[Path]) -> Path:
+        from .snapshot.unpacker import DirUnpacker
+        restore_dir = self.store.restore_dir()
+        index = BlobIndex(self.keys, restore_dir / "index")
+        index.load()
+        reader = PackfileReader(self.keys, restore_dir / "pack")
+        if len(index) == 0:  # no/partial index: rebuild from headers
+            index.rebuild_from_packfiles(reader, restore_dir / "pack")
+
+        def resolve(h):
+            pid = index.lookup(h)
+            if pid is None:
+                raise EngineError(f"blob {bytes(h).hex()} not restored")
+            return reader.get_blob(pid, h)
+
+        dest = Path(dest or (self.store.get_backup_path() or ""))
+        DirUnpacker(resolve, progress=self._pack_progress).unpack(
+            snapshot_hash, dest)
+        self._log(f"restore complete into {dest}")
+        return dest

@@ -1,0 +1,1 @@
+"""Networking: coordination server, client control plane, P2P data plane."""

@@ -1,0 +1,194 @@
+"""Client control plane: typed server RPCs + persistent push channel.
+
+Re-designs ``client/src/net_server/`` (requests.rs + mod.rs): every call is
+a typed JSON POST; authentication failures trigger one transparent re-login
+(``retry_with_login``, requests.rs:212-235); a persistent WebSocket carries
+server push messages (BackupMatched / IncomingP2PConnection /
+FinalizeP2PConnection / Ping) with an infinite reconnect loop
+(``net_server/mod.rs:26-55``).
+
+Server address resolution honors the ``SERVER_ADDR`` env seam the reference
+uses for testing (requests.rs:246-258).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Callable, Optional
+
+import aiohttp
+
+from .. import wire
+from ..crypto import KeyManager
+from ..store import Store
+
+
+class ServerError(Exception):
+    def __init__(self, kind: str, detail: str = ""):
+        super().__init__(f"{kind}: {detail}" if detail else kind)
+        self.kind = kind
+
+
+class Unauthorized(ServerError):
+    def __init__(self, detail: str = ""):
+        super().__init__("Unauthorized", detail)
+
+
+def server_addr() -> str:
+    return os.environ.get("SERVER_ADDR", "127.0.0.1:8080")
+
+
+class ServerClient:
+    """One client's control-plane connection to the coordination server."""
+
+    def __init__(self, keys: KeyManager, store: Store,
+                 addr: Optional[str] = None):
+        self.keys = keys
+        self.store = store
+        self.addr = addr or server_addr()
+        self.base = f"http://{self.addr}"
+        self._http: Optional[aiohttp.ClientSession] = None
+        self._ws_task: Optional[asyncio.Task] = None
+        self.on_backup_matched: Optional[Callable] = None
+        self.on_incoming_p2p: Optional[Callable] = None
+        self.on_finalize_p2p: Optional[Callable] = None
+        self.ws_connected = asyncio.Event()
+
+    async def _session(self) -> aiohttp.ClientSession:
+        if self._http is None or self._http.closed:
+            self._http = aiohttp.ClientSession()
+        return self._http
+
+    async def close(self) -> None:
+        if self._ws_task is not None:
+            self._ws_task.cancel()
+            try:
+                await self._ws_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._ws_task = None
+        if self._http is not None and not self._http.closed:
+            await self._http.close()
+
+    # --- raw RPC -----------------------------------------------------------
+
+    async def _post(self, path: str, msg: wire.JsonMessage) -> wire.JsonMessage:
+        http = await self._session()
+        async with http.post(self.base + path, data=msg.to_json()) as resp:
+            body = await resp.text()
+            try:
+                out = wire.JsonMessage.from_json(body)
+            except ValueError:
+                out = wire.Error(kind="BadResponse", detail=body[:200])
+            if resp.status == 401:
+                raise Unauthorized(getattr(out, "detail", ""))
+            if resp.status >= 400 or isinstance(out, wire.Error):
+                kind = getattr(out, "kind", f"HTTP{resp.status}")
+                raise ServerError(kind, getattr(out, "detail", ""))
+            return out
+
+    # --- identity flows (identity.rs) --------------------------------------
+
+    async def register(self) -> None:
+        challenge = await self._post("/register/begin",
+                                     wire.ClientRegistrationRequest(
+                                         pubkey=self.keys.client_id))
+        await self._post("/register/complete", wire.ClientRegistrationAuth(
+            pubkey=self.keys.client_id,
+            challenge_response=self.keys.sign(challenge.nonce)))
+
+    async def login(self) -> bytes:
+        challenge = await self._post("/login/begin", wire.ClientLoginRequest(
+            pubkey=self.keys.client_id))
+        out = await self._post("/login/complete", wire.ClientLoginAuth(
+            pubkey=self.keys.client_id,
+            challenge_response=self.keys.sign(challenge.nonce)))
+        self.store.set_auth_token(out.token)
+        return out.token
+
+    async def _token(self) -> bytes:
+        token = self.store.get_auth_token()
+        if token is None:
+            token = await self.login()
+        return token
+
+    async def _with_login(self, call):
+        """Re-auth once on 401 (requests.rs:212-235)."""
+        try:
+            return await call(await self._token())
+        except Unauthorized:
+            self.store.set_auth_token(None)
+            return await call(await self.login())
+
+    # --- typed API (requests.rs) -------------------------------------------
+
+    async def backup_storage_request(self, storage_required: int) -> None:
+        await self._with_login(lambda t: self._post(
+            "/backups/request",
+            wire.BackupRequest(session_token=t,
+                               storage_required=storage_required)))
+
+    async def backup_done(self, snapshot_hash: bytes) -> None:
+        await self._with_login(lambda t: self._post(
+            "/backups/done",
+            wire.BackupDone(session_token=t, snapshot_hash=snapshot_hash)))
+
+    async def backup_restore(self) -> wire.BackupRestoreInfo:
+        return await self._with_login(lambda t: self._post(
+            "/backups/restore", wire.BackupRestoreRequest(session_token=t)))
+
+    async def p2p_connection_begin(self, destination: bytes,
+                                   session_nonce: bytes) -> None:
+        await self._with_login(lambda t: self._post(
+            "/p2p/connection/begin", wire.BeginP2PConnectionRequest(
+                session_token=t, destination_client_id=destination,
+                session_nonce=session_nonce)))
+
+    async def p2p_connection_confirm(self, source: bytes, addr: str) -> None:
+        await self._with_login(lambda t: self._post(
+            "/p2p/connection/confirm", wire.ConfirmP2PConnectionRequest(
+                session_token=t, source_client_id=source,
+                destination_ip_address=addr)))
+
+    # --- push channel (net_server/mod.rs) ----------------------------------
+
+    def start_ws(self) -> asyncio.Task:
+        if self._ws_task is None or self._ws_task.done():
+            self._ws_task = asyncio.create_task(self._ws_loop())
+        return self._ws_task
+
+    async def _ws_loop(self) -> None:
+        while True:
+            try:
+                token = await self._token()
+                http = await self._session()
+                async with http.ws_connect(
+                        self.base + "/ws",
+                        headers={"Authorization": bytes(token).hex()}) as ws:
+                    self.ws_connected.set()
+                    async for msg in ws:
+                        if msg.type != aiohttp.WSMsgType.TEXT:
+                            break
+                        await self._dispatch(msg.data)
+            except Unauthorized:
+                self.store.set_auth_token(None)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+            self.ws_connected.clear()
+            await asyncio.sleep(0.2)
+
+    async def _dispatch(self, raw: str) -> None:
+        try:
+            msg = wire.JsonMessage.from_json(raw)
+        except ValueError:
+            return
+        # each push handled in its own task (net_server/mod.rs:58-90)
+        if isinstance(msg, wire.BackupMatched) and self.on_backup_matched:
+            asyncio.create_task(self.on_backup_matched(msg))
+        elif isinstance(msg, wire.IncomingP2PConnection) and self.on_incoming_p2p:
+            asyncio.create_task(self.on_incoming_p2p(msg))
+        elif isinstance(msg, wire.FinalizeP2PConnection) and self.on_finalize_p2p:
+            asyncio.create_task(self.on_finalize_p2p(msg))

@@ -1,0 +1,386 @@
+"""P2P data plane: signed, replay-protected, acked peer-to-peer transfer.
+
+Re-designs ``client/src/net_p2p/``: all backup bytes move client<->client
+over WebSocket, end-to-end authenticated:
+
+* Every message is an :class:`~backuwup_tpu.wire.EncapsulatedMsg` — an
+  Ed25519-signed :class:`~backuwup_tpu.wire.P2PBody` carrying a replay
+  header (random 16-byte session nonce + strictly-sequential sequence
+  number, ``p2p_message.rs:21-24``, ``receive.rs:95-105``).
+* Connections rendezvous through the coordination server: the initiator
+  registers a nonce (60 s expiry, ``p2p_connection_manager.rs``), the
+  acceptor binds a random port and confirms its address, the initiator
+  dials and sends the signed seq-0 request (``handle_connections.rs``).
+* Per-file acks with timeouts (``transport.rs:127-128``); packfiles are
+  deleted by the sender only after the ack (``send.rs:277-289``).
+* Hosts store received packfiles XOR-obfuscated with a local 4-byte key so
+  a casual host can't read foreign (already encrypted) packfiles
+  (``received_files_writer.rs:76-78``); quota = negotiated − received with
+  a 16 MiB grace (``:101-108``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from pathlib import Path
+from typing import Callable, Dict, Optional
+
+import numpy as np
+import websockets
+
+from .. import defaults, wire
+from ..crypto import KeyManager, verify_signature
+from ..store import Store
+
+PURPOSE_TRANSPORT = wire.RequestType.TRANSPORT
+PURPOSE_RESTORE = wire.RequestType.RESTORE_ALL
+
+
+class P2PError(Exception):
+    pass
+
+
+def obfuscate(data: bytes, key: bytes) -> bytes:
+    """XOR with a repeating 4-byte key (net_p2p/mod.rs:38-47); involutive."""
+    if len(key) != 4:
+        raise ValueError("obfuscation key must be 4 bytes")
+    arr = np.frombuffer(bytes(data), dtype=np.uint8)
+    pad = -len(arr) % 4
+    if pad:
+        arr = np.concatenate([arr, np.zeros(pad, np.uint8)])
+    k = np.frombuffer(bytes(key) * (len(arr) // 4), dtype=np.uint8)
+    out = (arr ^ k).tobytes()
+    return out[:len(data)]
+
+
+class ConnectionRequests:
+    """Outgoing-request registry: anti-unsolicited-connection bookkeeping
+    with expiry (p2p_connection_manager.rs:17-66)."""
+
+    def __init__(self, ttl_s: float = defaults.P2P_REQUEST_TTL_S):
+        self.ttl_s = ttl_s
+        self._pending: Dict[bytes, tuple] = {}  # peer -> (nonce, purpose, exp)
+
+    def add(self, peer_id: bytes, purpose: wire.RequestType) -> bytes:
+        nonce = os.urandom(wire.TRANSPORT_NONCE_LEN)
+        self._pending[bytes(peer_id)] = (nonce, purpose,
+                                         time.time() + self.ttl_s)
+        return nonce
+
+    def finalize(self, peer_id: bytes) -> tuple:
+        entry = self._pending.pop(bytes(peer_id), None)
+        if entry is None or entry[2] < time.time():
+            raise P2PError("no pending connection request for peer")
+        return entry[0], entry[1]
+
+
+def _sign_body(keys: KeyManager, body: wire.P2PBody) -> bytes:
+    encoded = body.encode_bytes()
+    return wire.EncapsulatedMsg(body=encoded,
+                                signature=keys.sign(encoded)).encode_bytes()
+
+
+def _verify_msg(raw: bytes, peer_id: bytes) -> wire.P2PBody:
+    if len(raw) > defaults.MAX_P2P_MESSAGE_SIZE:
+        raise P2PError("p2p message exceeds size cap")
+    msg = wire.EncapsulatedMsg.decode_bytes(raw)
+    if not verify_signature(peer_id, msg.body, msg.signature):
+        raise P2PError("bad message signature")
+    return wire.P2PBody.decode_bytes(msg.body)
+
+
+class Transport:
+    """Send side: ordered, signed, acked file transfer (transport.rs)."""
+
+    def __init__(self, ws, keys: KeyManager, peer_id: bytes,
+                 session_nonce: bytes, first_seq: int = 1):
+        self.ws = ws
+        self.keys = keys
+        self.peer_id = bytes(peer_id)
+        self.session_nonce = bytes(session_nonce)
+        self.seq = first_seq
+        self._acks: Dict[int, asyncio.Event] = {}
+        self._ack_task: Optional[asyncio.Task] = None
+        self._recv_queue: asyncio.Queue = asyncio.Queue()
+
+    def start(self) -> None:
+        if self._ack_task is None:
+            self._ack_task = asyncio.create_task(self._listen())
+
+    async def _listen(self) -> None:
+        """Verify + route incoming frames: acks release waiting senders,
+        data frames queue for the receive loop (duplex socket)."""
+        try:
+            async for raw in self.ws:
+                try:
+                    body = _verify_msg(raw, self.peer_id)
+                except P2PError:
+                    continue
+                if body.header.session_nonce != self.session_nonce:
+                    continue
+                if body.kind == wire.P2PBodyKind.ACK:
+                    ev = self._acks.get(body.acked_sequence)
+                    if ev is not None:
+                        ev.set()
+                else:
+                    await self._recv_queue.put(body)
+        except websockets.ConnectionClosed:
+            pass
+        finally:
+            await self._recv_queue.put(None)
+
+    async def send_data(self, data: bytes, file_info: wire.FileInfoKind,
+                        file_id: bytes) -> None:
+        """Send one file; waits for the signed ack (transport.rs:111-132)."""
+        seq = self.seq
+        self.seq += 1
+        body = wire.P2PBody(
+            kind=wire.P2PBodyKind.FILE,
+            header=wire.P2PHeader(sequence_number=seq,
+                                  session_nonce=self.session_nonce),
+            file_info=file_info, file_id=bytes(file_id), data=bytes(data))
+        ev = asyncio.Event()
+        self._acks[seq] = ev
+        try:
+            await asyncio.wait_for(self.ws.send(_sign_body(self.keys, body)),
+                                   defaults.PACKFILE_SEND_TIMEOUT_S)
+            await asyncio.wait_for(ev.wait(), defaults.ACK_TIMEOUT_S)
+        except (asyncio.TimeoutError, websockets.ConnectionClosed) as e:
+            raise P2PError(f"send/ack failed for seq {seq}: {e}") from e
+        finally:
+            self._acks.pop(seq, None)
+
+    async def close(self) -> None:
+        if self._ack_task is not None:
+            self._ack_task.cancel()
+        try:
+            await self.ws.close()
+        except Exception:
+            pass
+
+
+class Receiver:
+    """Receive side: strict-sequence validation + signed acks (receive.rs).
+
+    ``sink(file_info, file_id, data)`` persists one file; the loop ends when
+    the peer closes the socket.
+    """
+
+    def __init__(self, transport: Transport, sink: Callable,
+                 first_seq: int = 1):
+        self.t = transport
+        self.sink = sink
+        self.expected_seq = first_seq
+
+    async def run(self) -> int:
+        """Returns the number of files received."""
+        count = 0
+        while True:
+            body = await self.t._recv_queue.get()
+            if body is None:
+                return count
+            if body.kind != wire.P2PBodyKind.FILE:
+                continue
+            if body.header.sequence_number != self.expected_seq:
+                raise P2PError(
+                    f"sequence break: got {body.header.sequence_number}, "
+                    f"expected {self.expected_seq} (replay protection)")
+            await self.sink(body.file_info, body.file_id, body.data)
+            ack = wire.P2PBody(
+                kind=wire.P2PBodyKind.ACK,
+                header=wire.P2PHeader(sequence_number=self.expected_seq,
+                                      session_nonce=self.t.session_nonce),
+                acked_sequence=self.expected_seq)
+            await self.t.ws.send(_sign_body(self.t.keys, ack))
+            self.expected_seq += 1
+            count += 1
+
+
+class ReceivedFilesWriter:
+    """Store a peer's packfiles/indexes, obfuscated + quota-enforced
+    (received_files_writer.rs)."""
+
+    def __init__(self, store: Store, peer_id: bytes):
+        self.store = store
+        self.peer_id = bytes(peer_id)
+        self.dir = store.received_dir(peer_id)
+        key = store.get_obfuscation_key()
+        if key is None:
+            raise P2PError("obfuscation key not initialized")
+        self.key = key
+
+    def _quota_left(self) -> int:
+        peer = self.store.get_peer(self.peer_id)
+        negotiated = peer.bytes_negotiated if peer else 0
+        received = peer.bytes_received if peer else 0
+        return negotiated - received + defaults.PEER_OVERUSE_GRACE
+
+    async def sink(self, file_info: wire.FileInfoKind, file_id: bytes,
+                   data: bytes) -> None:
+        if len(data) > self._quota_left():
+            raise P2PError("peer exceeded negotiated storage quota")
+        sub = "index" if file_info == wire.FileInfoKind.INDEX else "pack"
+        d = self.dir / sub
+        d.mkdir(parents=True, exist_ok=True)
+        path = d / bytes(file_id).hex()
+        if path.exists():  # collision refusal (received_files_writer.rs:54-56)
+            raise P2PError(f"refusing to overwrite {path.name}")
+        path.write_bytes(obfuscate(data, self.key))
+        self.store.add_peer_received(self.peer_id, len(data))
+
+    def iter_stored(self):
+        """Yield (file_info, file_id, de-obfuscated bytes) of everything this
+        peer stored with us — the restore-serving source (restore_send.rs)."""
+        for sub, kind in (("pack", wire.FileInfoKind.PACKFILE),
+                          ("index", wire.FileInfoKind.INDEX)):
+            d = self.dir / sub
+            if not d.is_dir():
+                continue
+            for f in sorted(d.iterdir()):
+                yield kind, bytes.fromhex(f.name), obfuscate(f.read_bytes(),
+                                                             self.key)
+
+
+class RestoreFilesWriter:
+    """Save own packfiles coming back from a peer during restore
+    (restore_files_writer.rs)."""
+
+    def __init__(self, store: Store):
+        self.dir = store.restore_dir()
+        self.files = 0
+
+    async def sink(self, file_info: wire.FileInfoKind, file_id: bytes,
+                   data: bytes) -> None:
+        if file_info == wire.FileInfoKind.INDEX:
+            d = self.dir / "index"
+            name = f"{int.from_bytes(bytes(file_id)[:8], 'little'):06d}"
+        else:
+            d = self.dir / "pack" / bytes(file_id).hex()[:2]
+            name = bytes(file_id).hex()
+        d.mkdir(parents=True, exist_ok=True)
+        (d / name).write_bytes(data)
+        self.files += 1
+
+
+class P2PNode:
+    """Ties rendezvous + transport together for one client."""
+
+    def __init__(self, keys: KeyManager, store: Store, server_client,
+                 bind_host: str = "127.0.0.1"):
+        self.keys = keys
+        self.store = store
+        self.server = server_client
+        self.bind_host = bind_host
+        self.requests = ConnectionRequests()
+        self._finalize_waiters: Dict[bytes, asyncio.Queue] = {}
+        self.on_transport_request: Optional[Callable] = None
+        self.on_restore_request: Optional[Callable] = None
+        server_client.on_incoming_p2p = self._handle_incoming
+        server_client.on_finalize_p2p = self._handle_finalize
+
+    # --- outgoing (accept_and_connect, handle_connections.rs:94-139) -------
+
+    async def connect(self, peer_id: bytes, purpose: wire.RequestType,
+                      timeout: float = 15.0) -> Transport:
+        peer_id = bytes(peer_id)
+        nonce = self.requests.add(peer_id, purpose)
+        q = self._finalize_waiters.setdefault(peer_id, asyncio.Queue())
+        await self.server.p2p_connection_begin(peer_id, nonce)
+        try:
+            addr = await asyncio.wait_for(q.get(), timeout)
+        except asyncio.TimeoutError:
+            raise P2PError("peer did not confirm p2p connection")
+        nonce, purpose = self.requests.finalize(peer_id)
+        ws = None
+        for attempt in range(3):  # dial retries (handle_connections.rs:145-165)
+            try:
+                ws = await websockets.connect(
+                    f"ws://{addr}", max_size=defaults.MAX_P2P_MESSAGE_SIZE)
+                break
+            except OSError:
+                await asyncio.sleep(0.5)
+        if ws is None:
+            raise P2PError(f"could not dial peer at {addr}")
+        init = wire.P2PBody(
+            kind=wire.P2PBodyKind.REQUEST,
+            header=wire.P2PHeader(sequence_number=0, session_nonce=nonce),
+            request_type=purpose)
+        await ws.send(_sign_body(self.keys, init))
+        t = Transport(ws, self.keys, peer_id, nonce)
+        t.start()
+        return t
+
+    async def _handle_finalize(self, msg: wire.FinalizeP2PConnection) -> None:
+        q = self._finalize_waiters.setdefault(
+            bytes(msg.destination_client_id), asyncio.Queue())
+        await q.put(msg.destination_ip_address)
+
+    # --- incoming (accept_and_listen, handle_connections.rs:30-90) ---------
+
+    async def _handle_incoming(self, msg: wire.IncomingP2PConnection) -> None:
+        source = bytes(msg.source_client_id)
+        if self.store.get_peer(source) is None:
+            return  # unknown peer: refuse (handle_connections.rs:31-45)
+        expected_nonce = msg.session_nonce
+        accepted: asyncio.Queue = asyncio.Queue()
+
+        async def handler(ws):
+            try:
+                raw = await asyncio.wait_for(ws.recv(), 10)
+                body = _verify_msg(raw, source)
+                if (body.kind != wire.P2PBodyKind.REQUEST
+                        or body.header.sequence_number != 0
+                        or body.header.session_nonce != expected_nonce):
+                    await ws.close()
+                    return
+            except (P2PError, asyncio.TimeoutError,
+                    websockets.ConnectionClosed):
+                return
+            t = Transport(ws, self.keys, source, expected_nonce)
+            t.start()
+            done = asyncio.Event()
+            await accepted.put((body.request_type, t, done))
+            await done.wait()  # keep the ws handler alive while serving
+
+        # random high port (net_p2p/mod.rs:26-35)
+        server = await websockets.serve(
+            handler, self.bind_host, 0,
+            max_size=defaults.MAX_P2P_MESSAGE_SIZE)
+        port = server.sockets[0].getsockname()[1]
+        await self.server.p2p_connection_confirm(
+            source, f"{self.bind_host}:{port}")
+        try:
+            request_type, transport, done = await asyncio.wait_for(
+                accepted.get(), 30)
+        except asyncio.TimeoutError:
+            server.close()
+            return
+        try:
+            if request_type == wire.RequestType.TRANSPORT:
+                if self.on_transport_request is not None:
+                    await self.on_transport_request(source, transport)
+            elif request_type == wire.RequestType.RESTORE_ALL:
+                if self.on_restore_request is not None:
+                    await self.on_restore_request(source, transport)
+        finally:
+            done.set()
+            await transport.close()
+            server.close()
+
+    # --- restore serving (restore_send.rs) ---------------------------------
+
+    async def serve_restore(self, peer_id: bytes, transport: Transport) -> int:
+        """Stream everything ``peer_id`` stored with us back to them, with
+        a per-peer rate limit (restore_send.rs:22-94)."""
+        last = self.store.last_event_time(f"restore_served:{bytes(peer_id).hex()}")
+        if last is not None and time.time() - last < defaults.RESTORE_REQUEST_THROTTLE_S:
+            raise P2PError("restore request throttled")
+        self.store.add_event(f"restore_served:{bytes(peer_id).hex()}", {})
+        writer = ReceivedFilesWriter(self.store, peer_id)
+        sent = 0
+        for kind, file_id, data in writer.iter_stored():
+            await transport.send_data(data, kind, file_id)
+            sent += 1
+        return sent

@@ -1,0 +1,391 @@
+"""Coordination server: identity, matchmaking, rendezvous, snapshot registry.
+
+Re-designs the reference server (``server/src/``) on aiohttp.  The control
+plane never touches backup data (SURVEY.md §1): it does
+
+* **challenge-response auth** on Ed25519 client keys — 30 s challenge TTL,
+  24 h session TTL (``client_auth_manager.rs:17-20,49-101``),
+* **storage-request matchmaking** — an expiring queue; ``fulfill`` pops
+  candidates, matches ``min(remaining, candidate)``, notifies both clients
+  over their push channels, records the negotiation in both directions, and
+  re-enqueues remainders (``backup_request.rs:73-185``),
+* **P2P rendezvous relay** — forwards connection requests/confirmations
+  between clients (``handlers/p2p_connection_request.rs``),
+* **snapshot registry** — latest snapshot hash per client plus the peer
+  list needed for restore (``db.rs:129-187``, ``handlers/backup.rs``).
+
+Persistent state lives in SQLite (the reference uses Postgres via sqlx;
+an embedded store keeps the framework self-contained — the schema mirrors
+``server/schema/schema.sql``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sqlite3
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+from aiohttp import WSMsgType, web
+
+from .. import defaults, wire
+from ..crypto import verify_signature
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS clients (
+    pubkey BLOB PRIMARY KEY,
+    registered REAL NOT NULL,
+    last_login REAL
+);
+CREATE TABLE IF NOT EXISTS peer_backups (
+    source BLOB NOT NULL,
+    destination BLOB NOT NULL,
+    size_negotiated INTEGER NOT NULL,
+    timestamp REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS snapshots (
+    client_pubkey BLOB NOT NULL,
+    snapshot_hash BLOB NOT NULL,
+    timestamp REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS snapshots_by_client
+    ON snapshots (client_pubkey, timestamp);
+"""
+
+
+class ServerDB:
+    """server/src/db.rs equivalent (embedded)."""
+
+    def __init__(self, path):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.executescript(_SCHEMA)
+        self._db.commit()
+
+    def register_client(self, pubkey: bytes) -> None:
+        self._db.execute(
+            "INSERT OR IGNORE INTO clients (pubkey, registered) VALUES (?, ?)",
+            (pubkey, time.time()))
+        self._db.commit()
+
+    def client_exists(self, pubkey: bytes) -> bool:
+        return self._db.execute("SELECT 1 FROM clients WHERE pubkey = ?",
+                                (pubkey,)).fetchone() is not None
+
+    def client_update_logged_in(self, pubkey: bytes) -> None:
+        self._db.execute("UPDATE clients SET last_login = ? WHERE pubkey = ?",
+                         (time.time(), pubkey))
+        self._db.commit()
+
+    def save_storage_negotiated(self, source: bytes, destination: bytes,
+                                size: int) -> None:
+        self._db.execute(
+            "INSERT INTO peer_backups (source, destination, size_negotiated,"
+            " timestamp) VALUES (?, ?, ?, ?)",
+            (source, destination, size, time.time()))
+        self._db.commit()
+
+    def save_snapshot(self, pubkey: bytes, snapshot_hash: bytes) -> None:
+        self._db.execute(
+            "INSERT INTO snapshots (client_pubkey, snapshot_hash, timestamp)"
+            " VALUES (?, ?, ?)", (pubkey, snapshot_hash, time.time()))
+        self._db.commit()
+
+    def get_latest_client_snapshot(self, pubkey: bytes) -> Optional[bytes]:
+        row = self._db.execute(
+            "SELECT snapshot_hash FROM snapshots WHERE client_pubkey = ?"
+            " ORDER BY timestamp DESC LIMIT 1", (pubkey,)).fetchone()
+        return None if row is None else bytes(row[0])
+
+    def get_client_negotiated_peers(self, pubkey: bytes) -> list:
+        rows = self._db.execute(
+            "SELECT DISTINCT destination FROM peer_backups WHERE source = ?",
+            (pubkey,)).fetchall()
+        return [bytes(r[0]) for r in rows]
+
+
+class AuthManager:
+    """Challenges (30 s) and session tokens (24 h) with expiry
+    (client_auth_manager.rs)."""
+
+    def __init__(self):
+        self._challenges: Dict[bytes, tuple] = {}  # pubkey -> (nonce, expiry)
+        self._sessions: Dict[bytes, tuple] = {}  # token -> (pubkey, expiry)
+
+    def challenge_begin(self, pubkey: bytes) -> bytes:
+        nonce = os.urandom(wire.CHALLENGE_NONCE_LEN)
+        self._challenges[pubkey] = (
+            nonce, time.time() + defaults.AUTH_CHALLENGE_TTL_S)
+        return nonce
+
+    def challenge_verify(self, pubkey: bytes, signature: bytes) -> bool:
+        entry = self._challenges.pop(pubkey, None)
+        if entry is None or entry[1] < time.time():
+            return False
+        return verify_signature(pubkey, entry[0], signature)
+
+    def session_start(self, pubkey: bytes) -> bytes:
+        token = os.urandom(wire.SESSION_TOKEN_LEN)
+        self._sessions[token] = (pubkey, time.time() + defaults.SESSION_TTL_S)
+        return token
+
+    def get_session(self, token: Optional[bytes]) -> Optional[bytes]:
+        if token is None:
+            return None
+        entry = self._sessions.get(bytes(token))
+        if entry is None or entry[1] < time.time():
+            self._sessions.pop(bytes(token), None)
+            return None
+        return entry[0]
+
+
+class Connections:
+    """client-id -> WS push sink registry (server/src/ws.rs:73-109)."""
+
+    def __init__(self):
+        self._socks: Dict[bytes, web.WebSocketResponse] = {}
+
+    def register(self, client_id: bytes, ws: web.WebSocketResponse) -> None:
+        self._socks[bytes(client_id)] = ws
+
+    def unregister(self, client_id: bytes, ws: web.WebSocketResponse) -> None:
+        if self._socks.get(bytes(client_id)) is ws:
+            self._socks.pop(bytes(client_id), None)
+
+    def is_online(self, client_id: bytes) -> bool:
+        return bytes(client_id) in self._socks
+
+    async def notify(self, client_id: bytes, msg: wire.JsonMessage) -> bool:
+        ws = self._socks.get(bytes(client_id))
+        if ws is None or ws.closed:
+            return False
+        try:
+            await ws.send_str(msg.to_json())
+            return True
+        except (ConnectionError, RuntimeError):
+            self._socks.pop(bytes(client_id), None)
+            return False
+
+
+class StorageQueue:
+    """The matchmaking economy (backup_request.rs): an expiring queue of
+    (client, bytes-wanted) fulfilled by pairing clients with each other."""
+
+    def __init__(self, db: ServerDB, connections: Connections,
+                 expiry_s: float = defaults.BACKUP_REQUEST_EXPIRY_S):
+        self.db = db
+        self.connections = connections
+        self.expiry_s = expiry_s
+        self._queue: list = []  # (client_id, remaining, expires_at)
+        self._lock = asyncio.Lock()
+
+    def _pop_valid(self) -> Optional[tuple]:
+        now = time.time()
+        while self._queue:
+            client, remaining, expires = self._queue.pop(0)
+            if expires >= now and self.connections.is_online(client):
+                return client, remaining, expires
+        return None
+
+    async def fulfill(self, client_id: bytes, storage_required: int) -> None:
+        """Match against queued requests; both sides get BackupMatched for
+        min(remaining, candidate); remainders re-enqueue
+        (backup_request.rs:73-185)."""
+        if storage_required > defaults.MAX_BACKUP_STORAGE_REQUEST_SIZE:
+            raise ValueError("storage request exceeds protocol cap")
+        async with self._lock:
+            remaining = storage_required
+            while remaining > 0:
+                entry = self._pop_valid()
+                if entry is None:
+                    break
+                candidate, cand_remaining, cand_expires = entry
+                if candidate == bytes(client_id):
+                    continue  # self-match discarded
+                match = min(remaining, cand_remaining)
+                # notify both directions; record both directions
+                # (each side stores for the other)
+                await self.connections.notify(candidate, wire.BackupMatched(
+                    destination_id=bytes(client_id), storage_available=match))
+                await self.connections.notify(bytes(client_id),
+                                              wire.BackupMatched(
+                    destination_id=candidate, storage_available=match))
+                self.db.save_storage_negotiated(bytes(client_id), candidate,
+                                                match)
+                self.db.save_storage_negotiated(candidate, bytes(client_id),
+                                                match)
+                remaining -= match
+                cand_remaining -= match
+                if cand_remaining > 0:
+                    self._queue.append((candidate, cand_remaining,
+                                        cand_expires))
+            if remaining > 0:
+                self._queue.append((bytes(client_id), remaining,
+                                    time.time() + self.expiry_s))
+
+    def pending(self) -> int:
+        return len(self._queue)
+
+
+class CoordinationServer:
+    def __init__(self, db_path=":memory:"):
+        self.db = ServerDB(db_path)
+        self.auth = AuthManager()
+        self.connections = Connections()
+        self.queue = StorageQueue(self.db, self.connections)
+        self._runner: Optional[web.AppRunner] = None
+        self.port: Optional[int] = None
+
+    # --- helpers -----------------------------------------------------------
+
+    def _session(self, msg) -> bytes:
+        client = self.auth.get_session(msg.session_token)
+        if client is None:
+            raise web.HTTPUnauthorized(
+                text=wire.Error(kind="Unauthorized").to_json())
+        return client
+
+    @staticmethod
+    async def _parse(request, cls):
+        try:
+            msg = wire.JsonMessage.from_json(await request.text())
+        except (ValueError, KeyError) as e:
+            raise web.HTTPBadRequest(
+                text=wire.Error(kind="BadRequest", detail=str(e)).to_json())
+        if not isinstance(msg, cls):
+            raise web.HTTPBadRequest(
+                text=wire.Error(kind="BadRequest",
+                                detail=f"expected {cls.__name__}").to_json())
+        return msg
+
+    @staticmethod
+    def _ok(msg: wire.JsonMessage = None) -> web.Response:
+        return web.Response(text=(msg or wire.Ok()).to_json(),
+                            content_type="application/json")
+
+    # --- handlers (server/src/handlers/) -----------------------------------
+
+    async def register_begin(self, request):
+        msg = await self._parse(request, wire.ClientRegistrationRequest)
+        return self._ok(wire.ServerChallenge(
+            nonce=self.auth.challenge_begin(msg.pubkey)))
+
+    async def register_complete(self, request):
+        msg = await self._parse(request, wire.ClientRegistrationAuth)
+        if not self.auth.challenge_verify(msg.pubkey, msg.challenge_response):
+            raise web.HTTPUnauthorized(
+                text=wire.Error(kind="ChallengeFailed").to_json())
+        self.db.register_client(msg.pubkey)
+        return self._ok()
+
+    async def login_begin(self, request):
+        msg = await self._parse(request, wire.ClientLoginRequest)
+        if not self.db.client_exists(msg.pubkey):
+            raise web.HTTPUnauthorized(
+                text=wire.Error(kind="UnknownClient").to_json())
+        return self._ok(wire.ServerChallenge(
+            nonce=self.auth.challenge_begin(msg.pubkey)))
+
+    async def login_complete(self, request):
+        msg = await self._parse(request, wire.ClientLoginAuth)
+        if not self.auth.challenge_verify(msg.pubkey, msg.challenge_response):
+            raise web.HTTPUnauthorized(
+                text=wire.Error(kind="ChallengeFailed").to_json())
+        self.db.client_update_logged_in(msg.pubkey)
+        return self._ok(wire.LoginToken(token=self.auth.session_start(msg.pubkey)))
+
+    async def backup_request(self, request):
+        msg = await self._parse(request, wire.BackupRequest)
+        client = self._session(msg)
+        try:
+            await self.queue.fulfill(client, msg.storage_required)
+        except ValueError as e:
+            raise web.HTTPBadRequest(
+                text=wire.Error(kind="BadRequest", detail=str(e)).to_json())
+        return self._ok()
+
+    async def backup_done(self, request):
+        msg = await self._parse(request, wire.BackupDone)
+        client = self._session(msg)
+        self.db.save_snapshot(client, msg.snapshot_hash)
+        return self._ok()
+
+    async def backup_restore(self, request):
+        msg = await self._parse(request, wire.BackupRestoreRequest)
+        client = self._session(msg)
+        snapshot = self.db.get_latest_client_snapshot(client)
+        peers = self.db.get_client_negotiated_peers(client)
+        return self._ok(wire.BackupRestoreInfo(
+            snapshot_hash=snapshot, peers=[p.hex() for p in peers]))
+
+    async def p2p_begin(self, request):
+        msg = await self._parse(request, wire.BeginP2PConnectionRequest)
+        client = self._session(msg)
+        delivered = await self.connections.notify(
+            msg.destination_client_id, wire.IncomingP2PConnection(
+                source_client_id=client, session_nonce=msg.session_nonce))
+        if not delivered:
+            raise web.HTTPNotFound(
+                text=wire.Error(kind="DestinationOffline").to_json())
+        return self._ok()
+
+    async def p2p_confirm(self, request):
+        msg = await self._parse(request, wire.ConfirmP2PConnectionRequest)
+        client = self._session(msg)
+        delivered = await self.connections.notify(
+            msg.source_client_id, wire.FinalizeP2PConnection(
+                destination_client_id=client,
+                destination_ip_address=msg.destination_ip_address))
+        if not delivered:
+            raise web.HTTPNotFound(
+                text=wire.Error(kind="DestinationOffline").to_json())
+        return self._ok()
+
+    async def ws(self, request):
+        token = request.headers.get("Authorization")
+        client = self.auth.get_session(
+            bytes.fromhex(token) if token else None)
+        if client is None:
+            raise web.HTTPUnauthorized()
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        self.connections.register(client, ws)
+        try:
+            async for msg in ws:
+                if msg.type in (WSMsgType.ERROR, WSMsgType.CLOSE):
+                    break
+        finally:
+            self.connections.unregister(client, ws)
+        return ws
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def app(self) -> web.Application:
+        app = web.Application(client_max_size=1 << 20)
+        app.add_routes([
+            web.post("/register/begin", self.register_begin),
+            web.post("/register/complete", self.register_complete),
+            web.post("/login/begin", self.login_begin),
+            web.post("/login/complete", self.login_complete),
+            web.post("/backups/request", self.backup_request),
+            web.post("/backups/done", self.backup_done),
+            web.post("/backups/restore", self.backup_restore),
+            web.post("/p2p/connection/begin", self.p2p_begin),
+            web.post("/p2p/connection/confirm", self.p2p_confirm),
+            web.get("/ws", self.ws),
+        ])
+        return app
+
+    async def start(self, host="127.0.0.1", port=0) -> int:
+        self._runner = web.AppRunner(self.app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

@@ -84,9 +84,14 @@ class PeerInfo:
 class Store:
     """One client's persistent local state."""
 
-    def __init__(self, directory: Optional[Path] = None):
+    def __init__(self, directory: Optional[Path] = None,
+                 data_base: Optional[Path] = None):
         self.dir = Path(directory) if directory else config_dir()
         self.dir.mkdir(parents=True, exist_ok=True)
+        # data dir is per-store so N clients can share a process (the
+        # reference separates clients per-process via DATA_DIR; this is the
+        # in-process generalization of that seam)
+        self.data_base = Path(data_base) if data_base else data_dir()
         self._lock = threading.RLock()
         self._db = sqlite3.connect(self.dir / "config.db",
                                    check_same_thread=False)
@@ -161,17 +166,22 @@ class Store:
         self._set("highest_sent_index", str(int(idx)).encode())
 
     def packfile_dir(self) -> Path:
-        d = data_dir() / "packfiles"
+        d = self.data_base / "packfiles"
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def index_dir(self) -> Path:
+        d = self.data_base / "index"
         d.mkdir(parents=True, exist_ok=True)
         return d
 
     def received_dir(self, peer_id: bytes) -> Path:
-        d = data_dir() / "received_packfiles" / bytes(peer_id).hex()
+        d = self.data_base / "received_packfiles" / bytes(peer_id).hex()
         d.mkdir(parents=True, exist_ok=True)
         return d
 
     def restore_dir(self) -> Path:
-        d = data_dir() / "restore_packfiles"
+        d = self.data_base / "restore_packfiles"
         d.mkdir(parents=True, exist_ok=True)
         return d
 

@@ -1,0 +1,1 @@
+"""User interfaces: status messenger, CLI, web dashboard."""

@@ -1,0 +1,104 @@
+"""Status messenger: the progress/telemetry hub.
+
+Re-designs ``client/src/ui/ws_status_message.rs``: a process-wide pub/sub
+of log lines, lifecycle events, and debounced progress snapshots that UI
+front-ends (CLI, web dashboard, tests) subscribe to.  Progress updates are
+coalesced to at most one per 100 ms (``:134-141``); subscribers are
+lag-tolerant bounded queues (``ui/ws.rs:31-56``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+from typing import Callable, List, Optional
+
+from .. import defaults
+
+
+@dataclass
+class Progress:
+    """ws_status_message.rs:48-61."""
+
+    current_file: str = ""
+    files_done: int = 0
+    files_failed: int = 0
+    size_estimate: int = 0
+    bytes_on_disk: int = 0
+    bytes_transmitted: int = 0
+    running: bool = False
+
+
+@dataclass
+class StatusEvent:
+    kind: str  # message | progress | backup_started | backup_finished | ...
+    payload: dict = field(default_factory=dict)
+    ts: float = field(default_factory=time.time)
+
+    def to_json(self) -> str:
+        return json.dumps({"kind": self.kind, "payload": self.payload,
+                           "ts": self.ts}, sort_keys=True)
+
+
+class Messenger:
+    def __init__(self, debounce_s: float = defaults.PROGRESS_DEBOUNCE_S,
+                 history: int = 1000):
+        self._subs: List[Callable] = []
+        self._debounce_s = debounce_s
+        self._last_progress = 0.0
+        self.progress_state = Progress()
+        self.history: deque = deque(maxlen=history)
+
+    def subscribe(self, cb: Callable[[StatusEvent], None]) -> Callable:
+        self._subs.append(cb)
+        return lambda: self._subs.remove(cb)
+
+    def _emit(self, event: StatusEvent) -> None:
+        self.history.append(event)
+        for cb in list(self._subs):
+            try:
+                cb(event)
+            except Exception:
+                pass  # lag-tolerant: a broken subscriber never blocks others
+
+    # --- producers ---------------------------------------------------------
+
+    def log(self, message: str) -> None:
+        self._emit(StatusEvent("message", {"text": message}))
+
+    def progress(self, **fields) -> None:
+        """Debounced snapshot merge (at most one event per 100 ms)."""
+        p = self.progress_state
+        if "file" in fields:
+            p.current_file = fields.pop("file")
+            p.files_done += 1
+        for k, v in fields.items():
+            if hasattr(p, k):
+                setattr(p, k, v)
+        now = time.time()
+        if now - self._last_progress >= self._debounce_s:
+            self._last_progress = now
+            self._emit(StatusEvent("progress", asdict(p)))
+
+    def backup_started(self) -> None:
+        self.progress_state = Progress(running=True)
+        self._emit(StatusEvent("backup_started"))
+
+    def backup_finished(self, snapshot: bytes) -> None:
+        self.progress_state.running = False
+        self._emit(StatusEvent("backup_finished",
+                               {"snapshot": bytes(snapshot).hex()}))
+
+    def restore_started(self) -> None:
+        self.progress_state = Progress(running=True)
+        self._emit(StatusEvent("restore_started"))
+
+    def restore_finished(self) -> None:
+        self.progress_state.running = False
+        self._emit(StatusEvent("restore_finished"))
+
+    def panic(self, message: str) -> None:
+        """Fatal-error report hook (client main.rs:53-61 panic hook)."""
+        self._emit(StatusEvent("panic", {"text": message}))

@@ -1,0 +1,180 @@
+"""Coordination server + client control plane over loopback HTTP/WS."""
+
+import asyncio
+
+import pytest
+
+from backuwup_tpu import wire
+from backuwup_tpu.crypto import KeyManager
+from backuwup_tpu.net.client import ServerClient, ServerError, Unauthorized
+from backuwup_tpu.net.server import CoordinationServer
+from backuwup_tpu.store import Store
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def _client(tmp_path, name, port):
+    keys = KeyManager.from_secret(bytes([len(name)]) * 31 + name.encode()[:1])
+    store = Store(tmp_path / name)
+    return ServerClient(keys, store, addr=f"127.0.0.1:{port}")
+
+
+def test_register_login_and_session(tmp_path, loop):
+    async def run():
+        server = CoordinationServer()
+        port = await server.start()
+        c = _client(tmp_path, "a", port)
+        await c.register()
+        token = await c.login()
+        assert len(token) == 16
+        # authenticated call works
+        await c.backup_done(b"\x01" * 32)
+        assert server.db.get_latest_client_snapshot(c.keys.client_id) == b"\x01" * 32
+        # corrupt token: transparent re-login
+        c.store.set_auth_token(b"\x00" * 16)
+        await c.backup_done(b"\x02" * 32)
+        assert server.db.get_latest_client_snapshot(c.keys.client_id) == b"\x02" * 32
+        await c.close()
+        await server.stop()
+    loop.run_until_complete(run())
+
+
+def test_login_unknown_client_rejected(tmp_path, loop):
+    async def run():
+        server = CoordinationServer()
+        port = await server.start()
+        c = _client(tmp_path, "b", port)
+        with pytest.raises(ServerError):
+            await c.login()
+        await c.close()
+        await server.stop()
+    loop.run_until_complete(run())
+
+
+def test_storage_request_matching(tmp_path, loop):
+    """Two online clients with similar requests get matched both ways
+    (backup_request.rs:73-185)."""
+    async def run():
+        server = CoordinationServer()
+        port = await server.start()
+        a = _client(tmp_path, "a", port)
+        b = _client(tmp_path, "c", port)
+        matched_a, matched_b = [], []
+
+        async def on_a(msg):
+            matched_a.append(msg)
+
+        async def on_b(msg):
+            matched_b.append(msg)
+
+        for c, cb in ((a, on_a), (b, on_b)):
+            await c.register()
+            await c.login()
+            c.on_backup_matched = cb
+            c.start_ws()
+            await asyncio.wait_for(c.ws_connected.wait(), 5)
+
+        await a.backup_storage_request(100 * 1000 * 1000)
+        assert server.queue.pending() == 1
+        await b.backup_storage_request(60 * 1000 * 1000)
+        await asyncio.sleep(0.3)
+        # b's 60MB fully matched; a keeps 40MB queued
+        assert len(matched_a) == 1 and len(matched_b) == 1
+        assert matched_a[0].destination_id == b.keys.client_id
+        assert matched_a[0].storage_available == 60 * 1000 * 1000
+        assert matched_b[0].destination_id == a.keys.client_id
+        assert server.queue.pending() == 1
+        # ledger recorded both directions
+        assert server.db.get_client_negotiated_peers(a.keys.client_id) == \
+            [b.keys.client_id]
+        assert server.db.get_client_negotiated_peers(b.keys.client_id) == \
+            [a.keys.client_id]
+        await a.close()
+        await b.close()
+        await server.stop()
+    loop.run_until_complete(run())
+
+
+def test_oversized_storage_request_rejected(tmp_path, loop):
+    async def run():
+        server = CoordinationServer()
+        port = await server.start()
+        a = _client(tmp_path, "a", port)
+        await a.register()
+        await a.login()
+        with pytest.raises(ServerError):
+            await a.backup_storage_request(17 << 30)  # > 16 GiB cap
+        await a.close()
+        await server.stop()
+    loop.run_until_complete(run())
+
+
+def test_p2p_rendezvous_relay(tmp_path, loop):
+    """begin/confirm relays IncomingP2PConnection + FinalizeP2PConnection
+    (handlers/p2p_connection_request.rs)."""
+    async def run():
+        server = CoordinationServer()
+        port = await server.start()
+        a = _client(tmp_path, "a", port)
+        b = _client(tmp_path, "c", port)
+        incoming_b, finalize_a = [], []
+
+        async def on_incoming(msg):
+            incoming_b.append(msg)
+
+        async def on_finalize(msg):
+            finalize_a.append(msg)
+
+        a.on_finalize_p2p = on_finalize
+        b.on_incoming_p2p = on_incoming
+        for c in (a, b):
+            await c.register()
+            await c.login()
+            c.start_ws()
+            await asyncio.wait_for(c.ws_connected.wait(), 5)
+
+        nonce = b"\x07" * 16
+        await a.p2p_connection_begin(b.keys.client_id, nonce)
+        await asyncio.sleep(0.2)
+        assert len(incoming_b) == 1
+        assert incoming_b[0].source_client_id == a.keys.client_id
+        assert incoming_b[0].session_nonce == nonce
+
+        await b.p2p_connection_confirm(a.keys.client_id, "127.0.0.1:45678")
+        await asyncio.sleep(0.2)
+        assert len(finalize_a) == 1
+        assert finalize_a[0].destination_client_id == b.keys.client_id
+        assert finalize_a[0].destination_ip_address == "127.0.0.1:45678"
+
+        # relay to an offline destination errors
+        ghost = KeyManager.from_secret(b"\x0f" * 32)
+        with pytest.raises(ServerError):
+            await a.p2p_connection_begin(ghost.client_id, nonce)
+        await a.close()
+        await b.close()
+        await server.stop()
+    loop.run_until_complete(run())
+
+
+def test_restore_info(tmp_path, loop):
+    async def run():
+        server = CoordinationServer()
+        port = await server.start()
+        a = _client(tmp_path, "a", port)
+        await a.register()
+        await a.login()
+        info = await a.backup_restore()
+        assert info.snapshot_hash is None and info.peers == []
+        await a.backup_done(b"\x05" * 32)
+        server.db.save_storage_negotiated(a.keys.client_id, b"\x09" * 32, 100)
+        info = await a.backup_restore()
+        assert info.snapshot_hash == b"\x05" * 32
+        assert info.peers == [(b"\x09" * 32).hex()]
+        await a.close()
+        await server.stop()
+    loop.run_until_complete(run())

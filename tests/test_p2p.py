@@ -1,0 +1,177 @@
+"""P2P data plane over loopback: rendezvous, signed transfer, restore-back."""
+
+import asyncio
+
+import pytest
+
+from backuwup_tpu import defaults, wire
+from backuwup_tpu.crypto import KeyManager
+from backuwup_tpu.net.client import ServerClient
+from backuwup_tpu.net.p2p import (
+    P2PError,
+    P2PNode,
+    ReceivedFilesWriter,
+    RestoreFilesWriter,
+    obfuscate,
+)
+from backuwup_tpu.net.server import CoordinationServer
+from backuwup_tpu.store import Store
+
+
+def test_obfuscation_round_trip(rng):
+    data = rng.randbytes(123_123)
+    key = b"\xaa\x01\x7f\x33"
+    assert obfuscate(obfuscate(data, key), key) == data
+    assert obfuscate(data, key) != data
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+async def _make_node(tmp_path, name, port, monkeypatch_data_dir):
+    keys = KeyManager.from_secret(bytes([len(name)]) * 31 + name.encode()[:1])
+    store = Store(tmp_path / name / "cfg")
+    store.set_obfuscation_key(b"\x11\x22\x33\x44")
+    client = ServerClient(keys, store, addr=f"127.0.0.1:{port}")
+    await client.register()
+    await client.login()
+    node = P2PNode(keys, store, client)
+    client.start_ws()
+    await asyncio.wait_for(client.ws_connected.wait(), 5)
+    return keys, store, client, node
+
+
+def test_transfer_and_restore_cycle(tmp_path, loop, monkeypatch):
+    """A stores two packfiles + an index on B, then restores them back."""
+    monkeypatch.setenv("DATA_DIR", str(tmp_path / "b" / "data"))
+
+    async def run():
+        server = CoordinationServer()
+        port = await server.start()
+        ka, sa, ca, na = await _make_node(tmp_path, "a", port, None)
+        kb, sb, cb, nb = await _make_node(tmp_path, "b", port, None)
+
+        # peers know each other via a negotiated match (ledger rows)
+        sa.add_peer_negotiated(kb.client_id, 10_000_000)
+        sb.add_peer_negotiated(ka.client_id, 10_000_000)
+
+        received_done = asyncio.Event()
+
+        async def on_transport(source, transport):
+            from backuwup_tpu.net.p2p import Receiver
+            writer = ReceivedFilesWriter(sb, source)
+            await Receiver(transport, writer.sink).run()
+            received_done.set()
+
+        nb.on_transport_request = on_transport
+        nb.on_restore_request = lambda src, t: nb.serve_restore(src, t)
+
+        async def on_restore(source, transport):
+            await nb.serve_restore(source, transport)
+
+        nb.on_restore_request = on_restore
+
+        # --- A -> B transfer ------------------------------------------------
+        t = await na.connect(kb.client_id, wire.RequestType.TRANSPORT)
+        pid1, pid2 = b"\x01" * 12, b"\x02" * 12
+        data1, data2 = b"packfile-one" * 1000, b"packfile-two" * 2000
+        index0 = b"index-file-zero" * 100
+        await t.send_data(data1, wire.FileInfoKind.PACKFILE, pid1)
+        await t.send_data(data2, wire.FileInfoKind.PACKFILE, pid2)
+        await t.send_data(index0, wire.FileInfoKind.INDEX,
+                          (0).to_bytes(8, "little"))
+        await t.close()
+        await asyncio.wait_for(received_done.wait(), 10)
+
+        # stored obfuscated, accounted, de-obfuscatable
+        peer = sb.get_peer(ka.client_id)
+        assert peer.bytes_received == len(data1) + len(data2) + len(index0)
+        stored = list(ReceivedFilesWriter(sb, ka.client_id).iter_stored())
+        assert {s[1]: s[2] for s in stored if s[0] == wire.FileInfoKind.PACKFILE} \
+            == {pid1: data1, pid2: data2}
+        raw_on_disk = next(
+            (sb.received_dir(ka.client_id) / "pack" / pid1.hex()).parent.glob(
+                pid1.hex())).read_bytes()
+        assert raw_on_disk != data1  # obfuscated at rest
+
+        # --- A <- B restore -------------------------------------------------
+        restorer = RestoreFilesWriter(sa)
+        tr = await na.connect(kb.client_id, wire.RequestType.RESTORE_ALL)
+        from backuwup_tpu.net.p2p import Receiver
+        got = await Receiver(tr, restorer.sink).run()
+        assert got == 3
+        pack_dir = sa.restore_dir() / "pack" / pid1.hex()[:2]
+        assert (pack_dir / pid1.hex()).read_bytes() == data1
+
+        # immediate second restore is throttled (60 s rate limit)
+        tr2 = await na.connect(kb.client_id, wire.RequestType.RESTORE_ALL)
+        got2 = await Receiver(tr2, restorer.sink).run()
+        assert got2 == 0  # serve_restore raised before sending anything
+
+        await ca.close()
+        await cb.close()
+        await server.stop()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 60))
+
+
+def test_unknown_peer_connection_refused(tmp_path, loop, monkeypatch):
+    """B ignores rendezvous from clients not in its peer ledger."""
+    monkeypatch.setenv("DATA_DIR", str(tmp_path / "bx" / "data"))
+
+    async def run():
+        server = CoordinationServer()
+        port = await server.start()
+        ka, sa, ca, na = await _make_node(tmp_path, "ax", port, None)
+        kb, sb, cb, nb = await _make_node(tmp_path, "bx", port, None)
+        # no ledger rows: B refuses to even confirm
+        with pytest.raises(P2PError):
+            await na.connect(kb.client_id, wire.RequestType.TRANSPORT,
+                             timeout=1.5)
+        await ca.close()
+        await cb.close()
+        await server.stop()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 30))
+
+
+def test_quota_enforced(tmp_path, loop, monkeypatch):
+    monkeypatch.setenv("DATA_DIR", str(tmp_path / "bq" / "data"))
+    # shrink the overuse grace so a transport-sized file can exceed quota
+    monkeypatch.setattr(defaults, "PEER_OVERUSE_GRACE", 1024)
+
+    async def run():
+        server = CoordinationServer()
+        port = await server.start()
+        ka, sa, ca, na = await _make_node(tmp_path, "aq", port, None)
+        kb, sb, cb, nb = await _make_node(tmp_path, "bq", port, None)
+        sa.add_peer_negotiated(kb.client_id, 100)
+        sb.add_peer_negotiated(ka.client_id, 100)  # tiny quota
+
+        failures = []
+
+        async def on_transport(source, transport):
+            from backuwup_tpu.net.p2p import Receiver
+            writer = ReceivedFilesWriter(sb, source)
+            try:
+                await Receiver(transport, writer.sink).run()
+            except P2PError as e:
+                failures.append(e)
+
+        nb.on_transport_request = on_transport
+        t = await na.connect(kb.client_id, wire.RequestType.TRANSPORT)
+        big = b"\x00" * (defaults.PEER_OVERUSE_GRACE + 1000 + 100)
+        with pytest.raises(P2PError):  # no ack comes back
+            await t.send_data(big, wire.FileInfoKind.PACKFILE, b"\x03" * 12)
+        await t.close()
+        await asyncio.sleep(0.2)
+        assert failures, "receiver must reject over-quota file"
+        await ca.close()
+        await cb.close()
+        await server.stop()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 30))
