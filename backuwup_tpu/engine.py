@@ -249,12 +249,17 @@ class Engine:
         await self._send_index_files(orch, estimate, fulfilled)
 
     async def _send_index_files(self, orch, estimate, fulfilled) -> None:
-        watermark = self.store.get_highest_sent_index()
-        files = sorted(p for p in self._index_dir().iterdir()
-                       if p.name.isdigit() and int(p.name) > watermark)
-        if not files:
-            return
         while True:
+            # Re-filter by the persisted watermark every attempt so a retry
+            # after a mid-batch failure never re-sends files already acked
+            # (the peer's writer refuses overwrites, which would livelock).
+            # Mirrors send.rs re-checking highest_sent_index per file.
+            watermark = self.store.get_highest_sent_index()
+            files = sorted((p for p in self._index_dir().iterdir()
+                            if p.name.isdigit() and int(p.name) > watermark),
+                           key=lambda p: int(p.name))
+            if not files:
+                return
             transport, peer_id, _free = await self._get_peer_connection(
                 orch, estimate, fulfilled, 0.0)
             if transport is None:

@@ -204,13 +204,30 @@ class StorageQueue:
                 if candidate == bytes(client_id):
                     continue  # self-match discarded
                 match = min(remaining, cand_remaining)
-                # notify both directions; record both directions
-                # (each side stores for the other)
-                await self.connections.notify(candidate, wire.BackupMatched(
-                    destination_id=bytes(client_id), storage_available=match))
-                await self.connections.notify(bytes(client_id),
-                                              wire.BackupMatched(
-                    destination_id=candidate, storage_available=match))
+                # Notify candidate first; only record the negotiation once
+                # both pushes actually landed — a client must never be
+                # listed as a restore peer without having learned of the
+                # match (backup_request.rs:95-139 records after notify).
+                ok_cand = await self.connections.notify(
+                    candidate, wire.BackupMatched(
+                        destination_id=bytes(client_id),
+                        storage_available=match))
+                if not ok_cand:
+                    # Candidate unreachable: drop its queued request and try
+                    # the next one (backup_request.rs:166-173).
+                    continue
+                ok_self = await self.connections.notify(
+                    bytes(client_id), wire.BackupMatched(
+                        destination_id=candidate, storage_available=match))
+                if not ok_self:
+                    # The requester itself is unreachable: stop matching
+                    # entirely instead of draining the queue with matches
+                    # nobody records.  Re-enqueue the candidate (who was
+                    # notified of a match we won't record — it will re-request
+                    # on its own retry cadence) and discard the requester.
+                    self._queue.append((candidate, cand_remaining,
+                                        cand_expires))
+                    return
                 self.db.save_storage_negotiated(bytes(client_id), candidate,
                                                 match)
                 self.db.save_storage_negotiated(candidate, bytes(client_id),
@@ -344,8 +361,11 @@ class CoordinationServer:
 
     async def ws(self, request):
         token = request.headers.get("Authorization")
-        client = self.auth.get_session(
-            bytes.fromhex(token) if token else None)
+        try:
+            token_bytes = bytes.fromhex(token) if token else None
+        except ValueError:
+            raise web.HTTPUnauthorized()
+        client = self.auth.get_session(token_bytes)
         if client is None:
             raise web.HTTPUnauthorized()
         ws = web.WebSocketResponse(heartbeat=30)

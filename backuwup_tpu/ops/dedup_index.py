@@ -42,6 +42,14 @@ from .. import defaults
 
 KEY_WORDS = 4  # 128-bit stored fingerprint of the 256-bit blake3 hash
 
+# `lost` vector codes returned by the device insert kernel:
+LOST_RACE = 1  # lost an intra-batch empty-slot race — retryable
+LOST_EXHAUSTED = 2  # probe sequence exhausted (shard full) — not retryable
+
+
+class DedupIndexFull(RuntimeError):
+    """A shard's probe sequence was exhausted; the table needs resizing."""
+
 
 def hashes_to_queries(hashes) -> np.ndarray:
     """List of 32-byte digests -> (N, 4) u32 query words (first 16 bytes)."""
@@ -102,10 +110,15 @@ class ShardedDedupIndex:
         first = True
         while pending.size:
             found, lost = self._insert_once(queries[pending], values[pending])
+            if np.any(lost == LOST_EXHAUSTED):
+                raise DedupIndexFull(
+                    f"linear probe exhausted after {self.max_probes} steps; "
+                    f"shard too full/clustered — resize capacity "
+                    f"(currently {self.capacity}/shard)")
             if first:
                 out[pending] = found
                 first = False
-            pending = pending[np.asarray(lost).astype(bool)]
+            pending = pending[np.asarray(lost) == LOST_RACE]
         return out
 
     def _insert_once(self, queries: np.ndarray, values: np.ndarray):
@@ -176,7 +189,7 @@ def _build_probe_fn(mesh: Mesh, axis: str, capacity: int, max_probes: int,
         mine = owner == me
         # non-owned queries become empty (probe nothing, contribute 0)
         q_masked = jnp.where(mine[:, None], allq, jnp.uint32(0))
-        found, slot, _ = local_probe(keys, values, q_masked)
+        found, slot, done = local_probe(keys, values, q_masked)
         found = jnp.where(mine, found, jnp.uint32(0))
         if insert:
             allv = jax.lax.all_gather(ins_vals[0][0], axis).reshape(-1)
@@ -192,8 +205,13 @@ def _build_probe_fn(mesh: Mesh, axis: str, capacity: int, max_probes: int,
             upd_vals = values.at[tgt].set(
                 jnp.where(is_new, allv, jnp.uint32(0)), mode="drop")
             stored_key = upd_keys[jnp.clip(slot, 0, capacity - 1)]
-            lost = (is_new & ~jnp.all(stored_key == allq, axis=1)
-                    ).astype(jnp.uint32)
+            # done==False after max_probes means neither a hit nor an empty
+            # slot was seen: the key was NOT inserted.  Report it distinctly
+            # so the host can resize instead of silently dropping the key.
+            exhausted = mine & ~done
+            lost = ((is_new & ~jnp.all(stored_key == allq, axis=1)
+                     ).astype(jnp.uint32) * jnp.uint32(LOST_RACE)
+                    + exhausted.astype(jnp.uint32) * jnp.uint32(LOST_EXHAUSTED))
             found_all = jax.lax.psum(found, axis)
             lost_all = jax.lax.psum(lost, axis)
             myq = found_all.reshape(n_dev, -1)[me]

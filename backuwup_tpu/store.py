@@ -163,7 +163,11 @@ class Store:
         return -1 if v is None else int(v)
 
     def set_highest_sent_index(self, idx: int) -> None:
-        self._set("highest_sent_index", str(int(idx)).encode())
+        # Monotonic: the watermark means "every index file <= idx was acked",
+        # so it must never move backwards (a regression would re-send files
+        # the peer's writer refuses to overwrite).
+        self._set("highest_sent_index",
+                  str(max(int(idx), self.get_highest_sent_index())).encode())
 
     def packfile_dir(self) -> Path:
         d = self.data_base / "packfiles"

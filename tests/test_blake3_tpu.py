@@ -13,6 +13,10 @@ from backuwup_tpu.ops.blake3_tpu import (
 )
 
 EMPTY_DIGEST = "af1349b9f5f9a1a6a0404dea36dcc9499bcb25c9adc112b7cc9a93cae41f3262"
+# Official test vector: input = single byte 0x00 (the 0..250 repeating
+# pattern truncated to length 1), from BLAKE3's test_vectors.json.
+ONE_BYTE_DIGEST = (
+    "2d3adedff11b61f14c886e35afa036736dcd87a74d27b5c1510225d0f592e213")
 
 
 def _corpus():
@@ -25,6 +29,11 @@ def _corpus():
 
 def test_empty_vector():
     assert blake3_many_tpu([b""])[0].hex() == EMPTY_DIGEST
+
+
+def test_one_byte_official_vector():
+    assert blake3_hash(b"\x00").hex() == ONE_BYTE_DIGEST
+    assert blake3_many_tpu([b"\x00"])[0].hex() == ONE_BYTE_DIGEST
 
 
 def test_matches_scalar_spec():

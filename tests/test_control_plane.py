@@ -100,6 +100,69 @@ def test_storage_request_matching(tmp_path, loop):
     loop.run_until_complete(run())
 
 
+def test_matcher_requester_offline_does_not_drain_queue(loop):
+    """If the requester's push fails mid-fulfill, matching must stop:
+    candidates stay queued (re-enqueued) and nothing is recorded, instead
+    of popping every candidate with matches nobody records."""
+    from backuwup_tpu.net.server import ServerDB, StorageQueue
+
+    req = b"\x0a" * 32
+    cands = [bytes([i + 1]) * 32 for i in range(3)]
+
+    class FakeConnections:
+        def is_online(self, client_id):
+            return True
+
+        async def notify(self, client_id, msg):
+            return bytes(client_id) != req  # requester unreachable
+
+    db = ServerDB(":memory:")
+    q = StorageQueue(db, FakeConnections())
+
+    # seed the queue directly: calling fulfill() repeatedly would pair the
+    # candidates with each other before the requester arrives
+    import time as _time
+    for c in cands:
+        q._queue.append((c, 50 * 1000 * 1000, _time.time() + 300))
+
+    loop.run_until_complete(q.fulfill(req, 150 * 1000 * 1000))
+    # first candidate was re-enqueued, the others never popped
+    assert q.pending() == 3
+    assert db.get_client_negotiated_peers(req) == []
+    for c in cands:
+        assert db.get_client_negotiated_peers(c) == []
+
+
+def test_matcher_offline_candidate_skipped(loop):
+    """A candidate whose push fails is dropped; the next one matches and
+    both sides are recorded (backup_request.rs:166-173)."""
+    from backuwup_tpu.net.server import ServerDB, StorageQueue
+
+    req = b"\x0a" * 32
+    dead, alive = b"\x01" * 32, b"\x02" * 32
+
+    class FakeConnections:
+        def is_online(self, client_id):
+            return True
+
+        async def notify(self, client_id, msg):
+            return bytes(client_id) != dead
+
+    db = ServerDB(":memory:")
+    q = StorageQueue(db, FakeConnections())
+
+    async def run():
+        await q.fulfill(dead, 50 * 1000 * 1000)
+        await q.fulfill(alive, 50 * 1000 * 1000)
+        await q.fulfill(req, 50 * 1000 * 1000)
+
+    loop.run_until_complete(run())
+    assert q.pending() == 0
+    assert db.get_client_negotiated_peers(req) == [alive]
+    assert db.get_client_negotiated_peers(alive) == [req]
+    assert db.get_client_negotiated_peers(dead) == []
+
+
 def test_oversized_storage_request_rejected(tmp_path, loop):
     async def run():
         server = CoordinationServer()

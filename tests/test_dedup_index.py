@@ -7,6 +7,7 @@ import jax
 
 from backuwup_tpu.ops.blake3_cpu import blake3_hash
 from backuwup_tpu.ops.dedup_index import (
+    DedupIndexFull,
     ShardedDedupIndex,
     hashes_to_queries,
 )
@@ -74,6 +75,16 @@ def test_matches_host_index_classification(mesh):
             assert (f > 0) == (h in host), h.hex()
             if h not in host:
                 host[h] = True
+
+
+def test_probe_exhaustion_raises_not_silently_drops(mesh):
+    """Overfilling a shard must raise DedupIndexFull, never silently drop
+    keys (which would misclassify later duplicates as new)."""
+    idx = ShardedDedupIndex.create(mesh, capacity=8, max_probes=8)
+    hs = _hashes(512, seed=11)  # 512 keys into 8*8=64 slots: must overflow
+    q = hashes_to_queries(hs)
+    with pytest.raises(DedupIndexFull):
+        idx.insert(q, np.arange(512, dtype=np.uint32))
 
 
 def test_capacity_pressure_linear_probing(mesh):

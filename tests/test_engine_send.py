@@ -1,0 +1,125 @@
+"""Send-pipeline unit tests (engine.py) against fake transports.
+
+Regression coverage for the index-file watermark semantics: a retry after a
+mid-batch P2P failure must never re-send files the peer already acked
+(the peer's writer refuses overwrites — resending livelocks; reference
+send.rs re-checks highest_sent_index per file).
+"""
+
+import asyncio
+
+import pytest
+
+from backuwup_tpu import wire
+from backuwup_tpu.crypto import KeyManager
+from backuwup_tpu.engine import Engine, Orchestrator
+from backuwup_tpu.net.p2p import P2PError
+from backuwup_tpu.store import Store
+
+
+class FlakyTransport:
+    """Records sent index numbers; raises P2PError per a failure plan."""
+
+    def __init__(self, fail_on: set):
+        self.fail_on = set(fail_on)
+        self.sent = []
+
+    async def send_data(self, data, kind, file_id):
+        assert kind == wire.FileInfoKind.INDEX
+        num = int.from_bytes(file_id, "little")
+        if num in self.fail_on:
+            self.fail_on.discard(num)  # fail once, then succeed on retry
+            raise P2PError(f"injected failure on index {num}")
+        if num in self.sent:
+            raise AssertionError(
+                f"index file {num} re-sent after ack (livelock bug)")
+        self.sent.append(num)
+
+    async def close(self):
+        pass
+
+
+@pytest.fixture
+def engine(tmp_path):
+    keys = KeyManager.generate()
+    store = Store(directory=tmp_path / "cfg", data_base=tmp_path / "data")
+    eng = Engine(keys, store, server=None, node=None)
+    yield eng
+    store.close()
+
+
+def test_index_send_refilters_by_watermark_after_midbatch_failure(engine):
+    idx_dir = engine._index_dir()
+    for i in range(3):
+        (idx_dir / str(i)).write_bytes(b"index-%d" % i)
+
+    transport = FlakyTransport(fail_on={1})
+    peer = b"\x01" * 32
+
+    async def fake_get_peer(orch, estimate, fulfilled, last_request):
+        return transport, peer, 1 << 30
+
+    engine._get_peer_connection = fake_get_peer
+    orch = Orchestrator()
+
+    async def run():
+        await asyncio.wait_for(
+            engine._send_index_files(orch, 0, 0), timeout=10)
+
+    asyncio.new_event_loop().run_until_complete(run())
+    # 0 sent, 1 failed once then retried, 2 sent — each exactly once
+    assert transport.sent == [0, 1, 2]
+    assert engine.store.get_highest_sent_index() == 2
+
+
+def test_index_send_numeric_order_with_ten_plus_files(engine):
+    """11+ index files must go in numeric order (lexicographic Path order
+    would send '10' before '2', regressing the watermark and skipping
+    files on retry)."""
+    idx_dir = engine._index_dir()
+    for i in range(12):
+        (idx_dir / str(i)).write_bytes(b"x")
+
+    transport = FlakyTransport(fail_on={10})
+    peer = b"\x03" * 32
+
+    async def fake_get_peer(orch, estimate, fulfilled, last_request):
+        return transport, peer, 1 << 30
+
+    engine._get_peer_connection = fake_get_peer
+
+    async def run():
+        await asyncio.wait_for(
+            engine._send_index_files(Orchestrator(), 0, 0), timeout=10)
+
+    asyncio.new_event_loop().run_until_complete(run())
+    assert transport.sent == list(range(12))
+    assert engine.store.get_highest_sent_index() == 11
+
+
+def test_watermark_is_monotonic(engine):
+    engine.store.set_highest_sent_index(7)
+    engine.store.set_highest_sent_index(3)  # must not regress
+    assert engine.store.get_highest_sent_index() == 7
+
+
+def test_index_send_skips_already_watermarked(engine):
+    idx_dir = engine._index_dir()
+    for i in range(4):
+        (idx_dir / str(i)).write_bytes(b"x")
+    engine.store.set_highest_sent_index(1)
+
+    transport = FlakyTransport(fail_on=set())
+    peer = b"\x02" * 32
+
+    async def fake_get_peer(orch, estimate, fulfilled, last_request):
+        return transport, peer, 1 << 30
+
+    engine._get_peer_connection = fake_get_peer
+
+    async def run():
+        await asyncio.wait_for(
+            engine._send_index_files(Orchestrator(), 0, 0), timeout=10)
+
+    asyncio.new_event_loop().run_until_complete(run())
+    assert transport.sent == [2, 3]
