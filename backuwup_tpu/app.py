@@ -28,7 +28,8 @@ class ClientApp:
                  data_dir: Optional[Path] = None,
                  server_addr: Optional[str] = None,
                  backend: Optional[ChunkerBackend] = None,
-                 messenger: Optional[Messenger] = None):
+                 messenger: Optional[Messenger] = None,
+                 dedup_mesh=None):
         self.store = Store(config_dir, data_base=data_dir)
         self.messenger = messenger or Messenger()
         secret = self.store.get_root_secret()
@@ -48,7 +49,8 @@ class ClientApp:
         self.node.on_restore_request = self._serve_restore
         self.server.on_backup_matched = self._backup_matched
         self.engine = Engine(self.keys, self.store, self.server, self.node,
-                             backend=backend, messenger=self.messenger)
+                             backend=backend, messenger=self.messenger,
+                             dedup_mesh=dedup_mesh)
 
     @property
     def client_id(self) -> bytes:

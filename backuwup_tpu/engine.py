@@ -79,7 +79,7 @@ class Orchestrator:
 class Engine:
     def __init__(self, keys: KeyManager, store: Store, server: ServerClient,
                  node: P2PNode, backend: Optional[ChunkerBackend] = None,
-                 messenger=None):
+                 messenger=None, dedup_mesh=None):
         self.keys = keys
         self.store = store
         self.server = server
@@ -88,7 +88,14 @@ class Engine:
         self.messenger = messenger
         self.index = BlobIndex(keys, self._index_dir())
         self.index.load()
+        # with a mesh attached, dedup decisions run batched on the sharded
+        # HBM table; BlobIndex stays the persisted authority + parity oracle
+        self.device_dedup = None
+        if dedup_mesh is not None:
+            from .snapshot.device_dedup import MeshDedupIndex
+            self.device_dedup = MeshDedupIndex(dedup_mesh, self.index)
         self.orchestrator = Orchestrator()
+        self.last_pack_stats = None
 
     # --- paths -------------------------------------------------------------
 
@@ -163,7 +170,9 @@ class Engine:
                 on_packfile=self._on_packfile_threadsafe(loop))
             packer = DirPacker(self.backend, writer, self.index,
                                progress=self._pack_progress,
-                               should_pause=orch.block_if_paused)
+                               should_pause=orch.block_if_paused,
+                               dedup_batch=(self.device_dedup.classify_insert
+                                            if self.device_dedup else None))
             snapshot_holder["hash"] = packer.pack(root)
             snapshot_holder["stats"] = packer.stats
 
@@ -182,6 +191,7 @@ class Engine:
         except asyncio.CancelledError:
             raise EngineError("send pipeline cancelled")
         snapshot = snapshot_holder["hash"]
+        self.last_pack_stats = snapshot_holder["stats"]
         await self.server.backup_done(snapshot)
         self.store.add_event(EVENT_BACKUP, {
             "size": snapshot_holder["stats"].bytes_read,

@@ -121,17 +121,41 @@ class CpuBackend(ChunkerBackend):
 
 
 class TpuBackend(ChunkerBackend):
+    """Device-resident execution: ``manifest_many`` stages each batch into
+    HBM once and runs scan -> cut -> HBM-to-HBM chunk gather -> batched
+    digest (:meth:`DevicePipeline.manifest_batch`) — no per-chunk host
+    slicing.  ``chunk``/``digest_many`` remain for the streaming path and
+    as the op-level seams the parity tests pin."""
+
     name = "tpu"
 
     def __init__(self, params: Optional[CDCParams] = None):
         self.params = params or CDCParams()
         self._scanner = TpuCdcScanner(self.params)
+        self._pipeline = None
+
+    @property
+    def pipeline(self):
+        if self._pipeline is None:
+            from .pipeline import CHUNK_LEN, DevicePipeline
+            l_bucket = max(16, -(-self.params.max_size // CHUNK_LEN))
+            self._pipeline = DevicePipeline(self.params, l_bucket=l_bucket)
+        return self._pipeline
 
     def chunk(self, data):
         return self._scanner.chunk_stream(data)
 
     def digest_many(self, datas):
         return blake3_many_tpu(datas)
+
+    def manifest_many(self, streams):
+        results = self.pipeline.manifest_batch(streams)
+        out = []
+        for chunks, digests in results:
+            out.append([
+                ChunkRef(offset=off, length=ln, hash=digests[k].tobytes())
+                for k, (off, ln) in enumerate(chunks)])
+        return out
 
 
 def _accelerator_attached() -> bool:

@@ -25,11 +25,37 @@ import numpy as np
 
 from .. import defaults
 from .blake3_tpu import digest_padded
+from .cdc_cpu import chunk_stream as chunk_stream_cpu
 from .cdc_cpu import cuts_to_chunks, select_cuts
-from .cdc_tpu import _HALO, TpuCdcScanner, _decode_words, _scan_segment
+from .cdc_tpu import (
+    _HALO,
+    TpuCdcScanner,
+    _decode_words,
+    _scan_segment,
+    _segment_bucket,
+)
+from .blake3_tpu import blake3_many_tpu
 from .gear import CDCParams
 
 CHUNK_LEN = 1024
+
+# cap on one vmapped-scan dispatch (rows x row bytes)
+_SCAN_DISPATCH_BYTES = 128 * 1024 * 1024
+
+
+@functools.partial(jax.jit, static_argnames=("k_cap",))
+def _scan_batch(ext_b: jnp.ndarray, n_valid_b: jnp.ndarray,
+                mask_s: jnp.ndarray, mask_l: jnp.ndarray, *, k_cap: int):
+    """``vmap`` of the segment scan over a ``(B, _HALO + P)`` stream batch.
+
+    Each row is an independent stream (zero halo = stream start).  This is
+    the many-small-files form of the CDC scan: one device dispatch hashes
+    every file of a batch (the reference chunks files one at a time,
+    ``dir_packer.rs:246-266``).
+    """
+    return jax.vmap(
+        lambda e, nv: _scan_segment(e, nv, mask_s, mask_l, k_cap=k_cap)
+    )(ext_b, n_valid_b)
 
 
 @functools.partial(jax.jit, static_argnames=("l_bucket",))
@@ -84,6 +110,117 @@ class DevicePipeline:
             select_cuts(pos_l[is_s], pos_l, n_valid, p))
         digests = self.digest_chunks(stream, chunks)
         return chunks, digests
+
+    def manifest_batch(self, streams) -> List[Tuple[List[tuple], np.ndarray]]:
+        """Chunk + fingerprint a batch of independent streams, resident.
+
+        Each stream's bytes are staged into HBM exactly once: streams are
+        bucketed by padded length, scanned with one vmapped dispatch per
+        bucket, cut selection runs on the host over the sparse candidate
+        words (tiny transfer), and chunk buffers are gathered HBM->HBM out
+        of the same resident batch before the batched BLAKE3.  Returns a
+        ``(chunks, digests)`` pair per stream, bit-identical to the CPU
+        oracle pipeline.
+        """
+        p = self.params
+        out: List[Optional[Tuple[List[tuple], np.ndarray]]] = [None] * len(streams)
+        tiny: List[int] = []
+        groups: dict = {}
+        for i, s in enumerate(streams):
+            n = len(s)
+            if n == 0:
+                out[i] = ([], np.zeros((0, 32), dtype=np.uint8))
+            elif n <= p.min_size:
+                # sub-min streams are always exactly one chunk (select_cuts
+                # first rule), so the scan is skipped entirely — many tiny
+                # files cost one batched digest, not 64 KiB-padded scans
+                tiny.append(i)
+            elif n > self.scanner.segment_size:
+                # long stream: segmented device scan, then resident digest
+                chunks = self.scanner.chunk_stream(s)
+                dev = jnp.asarray(np.frombuffer(bytes(s), dtype=np.uint8))
+                out[i] = (chunks, self.digest_chunks(dev, chunks))
+            else:
+                groups.setdefault(_segment_bucket(n), []).append(i)
+        if tiny:
+            digs = blake3_many_tpu([streams[i] for i in tiny])
+            for i, d in zip(tiny, digs):
+                out[i] = ([(0, len(streams[i]))],
+                          np.frombuffer(d, dtype=np.uint8).reshape(1, 32))
+        for padded, idxs in sorted(groups.items()):
+            row = _HALO + padded
+            # bound one scan dispatch (the hash pass peaks at ~9 bytes of
+            # HBM per stream byte) and pad the row count to a power of two
+            # so arbitrary per-directory batch sizes reuse a handful of
+            # compiled shapes
+            max_rows = max(1, _SCAN_DISPATCH_BYTES // row)
+            for s0 in range(0, len(idxs), max_rows):
+                part = idxs[s0:s0 + max_rows]
+                B = 8
+                while B < len(part):
+                    B *= 2
+                buf = np.zeros((B, row), dtype=np.uint8)
+                nv = np.zeros(B, dtype=np.int32)
+                for r, i in enumerate(part):
+                    d = np.frombuffer(bytes(streams[i]), dtype=np.uint8)
+                    buf[r, _HALO:_HALO + len(d)] = d
+                    nv[r] = len(d)
+                results = self.manifest_resident_batch(jnp.asarray(buf), nv)
+                for r, i in enumerate(part):
+                    out[i] = results[r]
+        return out
+
+    def manifest_resident_batch(self, buf_d: jnp.ndarray, nv: np.ndarray,
+                                strict_overflow: bool = False,
+                                ) -> List[Tuple[List[tuple], np.ndarray]]:
+        """The device core of :meth:`manifest_batch`: one resident
+        ``(B, _HALO + P)`` batch -> per-row (chunks, digests).
+
+        ``buf_d`` rows are ``_HALO`` zero bytes then the stream (zero-padded
+        to P); ``nv`` holds true lengths.  This is the exact code path the
+        engine's backup runs per batch — ``bench.py`` times it directly.
+        ``strict_overflow`` raises on sparse-capacity overflow instead of
+        falling back to the CPU oracle (benchmarks must not silently time
+        the oracle).
+        """
+        p = self.params
+        B, row = int(buf_d.shape[0]), int(buf_d.shape[1])
+        padded = row - _HALO
+        k_cap = self.scanner._k_cap(padded)
+        widx, wl, ws, nz = _scan_batch(
+            buf_d, jnp.asarray(np.asarray(nv, dtype=np.int32)),
+            jnp.uint32(p.mask_s), jnp.uint32(p.mask_l), k_cap=k_cap)
+        widx, wl, ws, nz = (np.asarray(widx), np.asarray(wl),
+                            np.asarray(ws), np.asarray(nz))
+        flat = buf_d.reshape(-1)
+        all_chunks: List[tuple] = []  # absolute (offset, length) in flat
+        per_row: List[List[tuple]] = []
+        for r in range(B):
+            n = int(nv[r])
+            if int(nz[r]) > k_cap:
+                if strict_overflow:
+                    raise RuntimeError(
+                        f"candidate overflow: {int(nz[r])} words > {k_cap}")
+                # sparse capacity overflow (adversarial data): oracle
+                # rescan of this one stream keeps output bit-identical
+                row_bytes = bytes(
+                    np.asarray(buf_d[r, _HALO:_HALO + n]))
+                chunks = chunk_stream_cpu(row_bytes, p)
+            else:
+                pos_l, is_s = _decode_words(widx[r], wl[r], ws[r], k_cap, 0)
+                chunks = cuts_to_chunks(
+                    select_cuts(pos_l[is_s], pos_l, n, p))
+            per_row.append(chunks)
+            base = r * row + _HALO
+            all_chunks.extend((base + off, ln) for off, ln in chunks)
+        digests = self.digest_chunks(flat, all_chunks)
+        out: List[Tuple[List[tuple], np.ndarray]] = []
+        pos = 0
+        for r in range(B):
+            k = len(per_row[r])
+            out.append((per_row[r], digests[pos:pos + k]))
+            pos += k
+        return out
 
     def _chunk_bucket(self, n_bytes: int) -> int:
         """Smallest leaf bucket (power of two, >=16 chunks) holding a chunk;

@@ -87,6 +87,15 @@ class BlobIndex:
     def packfile_ids(self) -> Set[bytes]:
         return set(self._map.values())
 
+    def known_hashes(self) -> List[bytes]:
+        """Every hash the index answers is_duplicate=True for (committed and
+        queued) — the seed set for the device-resident dedup table."""
+        return list(self._map.keys() | self._queued)
+
+    @property
+    def queued_count(self) -> int:
+        return len(self._queued)
+
     def __len__(self) -> int:
         return len(self._map)
 

@@ -1,0 +1,112 @@
+"""Device-accelerated dedup front: batched classify on the sharded HBM table.
+
+The reference answers "have I stored this blob?" one binary-search at a time
+on the host (``blob_index.rs:130-148``).  Here the question is asked for a
+whole batch of fingerprints in one device program against the
+:class:`~backuwup_tpu.ops.dedup_index.ShardedDedupIndex` — the hash table
+sharded over the mesh in HBM, probed via ``all_gather``/``psum`` collectives
+(SURVEY.md section 7 step 3e).
+
+:class:`MeshDedupIndex` is the bridge into the engine:
+
+* the dedup *decision* for every chunk batch comes from the device table,
+* :class:`~backuwup_tpu.snapshot.blob_index.BlobIndex` remains the persisted
+  authority (hash -> packfile mapping, encrypted index files) and the parity
+  oracle — the packer asserts both agree on every classification,
+* table pressure (:class:`DedupIndexFull`) triggers an automatic capacity
+  doubling with a reseed from the host authority, so the device table is a
+  cache that can always be rebuilt — the same reconstructibility stance the
+  reference takes for its index files (``blob_index.rs:23-43``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+from jax.sharding import Mesh
+
+from .. import defaults
+from ..ops.dedup_index import (
+    DedupIndexFull,
+    ShardedDedupIndex,
+    hashes_to_queries,
+)
+from .blob_index import BlobIndex
+
+_SEED_BATCH = 8192
+
+
+class MeshDedupIndex:
+    """Batched membership classify+insert over a device mesh."""
+
+    def __init__(self, mesh: Mesh, host_index: BlobIndex,
+                 axis: str = "data",
+                 capacity: Optional[int] = None):
+        self.mesh = mesh
+        self.axis = axis
+        self.host = host_index
+        n_dev = mesh.shape[axis]
+        known = len(host_index) + host_index.queued_count
+        need = max(defaults.DEDUP_SHARD_CAPACITY,
+                   _next_pow2(4 * max(known, 1) // max(n_dev, 1)))
+        self.capacity = capacity or need
+        self._rebuild()
+
+    def _rebuild(self) -> None:
+        self.sharded = ShardedDedupIndex.create(
+            self.mesh, self.axis, capacity=self.capacity)
+        hashes = self.host.known_hashes()
+        for s in range(0, len(hashes), _SEED_BATCH):
+            batch = hashes[s:s + _SEED_BATCH]
+            self.sharded.insert(
+                hashes_to_queries(batch),
+                np.ones(len(batch), dtype=np.uint32))
+
+    def _grow(self) -> None:
+        self.capacity *= 2
+        self._rebuild()
+
+    def classify_insert(self, hashes: List[bytes]) -> List[bool]:
+        """is-duplicate flag per hash; new hashes become table-resident.
+
+        Intra-batch repeats are resolved host-side (first occurrence "new",
+        the rest "duplicate") because the device kernel's contract requires
+        distinct keys per batch (dedup_index.py module doc).
+        """
+        hashes = [bytes(h) for h in hashes]
+        if not hashes:
+            return []
+        first: dict = {}
+        uniq: List[bytes] = []
+        for h in hashes:
+            if h not in first:
+                first[h] = len(uniq)
+                uniq.append(h)
+        q = hashes_to_queries(uniq)
+        vals = np.ones(len(uniq), dtype=np.uint32)
+        while True:
+            try:
+                found = self.sharded.insert(q, vals)
+                break
+            except DedupIndexFull:
+                # all previously classified hashes are host-known by the
+                # time the next classify runs, so reseed-from-host plus a
+                # retry of this batch loses nothing
+                self._grow()
+        flags: List[bool] = []
+        seen: set = set()
+        for h in hashes:
+            if h in seen:
+                flags.append(True)
+            else:
+                seen.add(h)
+                flags.append(bool(found[first[h]] > 0))
+        return flags
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p

@@ -48,26 +48,52 @@ class DirPacker:
                  index: BlobIndex,
                  progress: Optional[Callable] = None,
                  batch_bytes: int = 256 * defaults.MiB,
-                 should_pause: Optional[Callable] = None):
+                 should_pause: Optional[Callable] = None,
+                 dedup_batch: Optional[Callable] = None):
         self.backend = backend
         self.writer = writer
         self.index = index
         self.progress = progress or (lambda **kw: None)
         self.batch_bytes = batch_bytes
         self.should_pause = should_pause or (lambda: None)
+        # device dedup front: batched is-duplicate classify+insert
+        # (MeshDedupIndex.classify_insert); None = host-only dedup
+        self.dedup_batch = dedup_batch
+        self._device_sync: List[bytes] = []
         self.stats = PackStats()
 
     # --- blob plumbing -----------------------------------------------------
 
-    def _add_blob(self, blob_hash: bytes, kind: BlobKind, data: bytes) -> None:
-        """Dedup-then-pack one blob (pack.rs:31-55 semantics)."""
-        if self.index.is_duplicate(blob_hash):
+    def _add_blob(self, blob_hash: bytes, kind: BlobKind, data: bytes,
+                  dup_hint: Optional[bool] = None) -> None:
+        """Dedup-then-pack one blob (pack.rs:31-55 semantics).
+
+        ``dup_hint`` is the device table's classification when the blob was
+        part of a batched classify; host and device must agree — a mismatch
+        means the two dedup authorities diverged, which would corrupt the
+        incremental-backup story, so it fails loudly.
+        """
+        host_dup = self.index.is_duplicate(blob_hash)
+        if dup_hint is not None and dup_hint != host_dup:
+            raise RuntimeError(
+                f"device/host dedup divergence on {bytes(blob_hash).hex()}: "
+                f"device={dup_hint} host={host_dup}")
+        if dup_hint is None and self.dedup_batch is not None:
+            # blob classified host-side only (tree node or streamed chunk):
+            # sync it into the device table at the next batch boundary
+            self._device_sync.append(bytes(blob_hash))
+        if host_dup:
             self.stats.chunks_deduped += 1
             self.stats.bytes_deduped += len(data)
             return
         self.index.mark_queued(blob_hash)
         self.should_pause()
         self.writer.add_blob(Blob(hash=blob_hash, kind=kind, data=data))
+
+    def _flush_device_sync(self) -> None:
+        if self.dedup_batch is not None and self._device_sync:
+            self.dedup_batch(self._device_sync)
+            self._device_sync.clear()
 
     def _add_tree(self, tree: Tree) -> bytes:
         encoded = tree.encode_bytes()
@@ -102,17 +128,31 @@ class DirPacker:
             if not batch_idx:
                 return
             manifests = self.backend.manifest_many(batch_data)
+            hints = iter(())
+            if self.dedup_batch is not None:
+                # blobs classified host-side since the last batch (streamed
+                # chunks, tree nodes) must reach the device table BEFORE the
+                # new batch is classified, or a re-occurrence of one of them
+                # would read as device-new/host-dup and trip the divergence
+                # guard in _add_blob
+                self._flush_device_sync()
+                # one device round-trip classifies every chunk of the batch
+                # against the sharded HBM table (SURVEY.md section 7 3e)
+                hints = iter(self.dedup_batch(
+                    [ref.hash for m in manifests for ref in m]))
             for i, data, meta, manifest in zip(batch_idx, batch_data,
                                                batch_meta, manifests):
                 for ref in manifest:
                     self.stats.chunks += 1
                     self._add_blob(ref.hash, BlobKind.FILE_CHUNK,
-                                   data[ref.offset:ref.offset + ref.length])
+                                   data[ref.offset:ref.offset + ref.length],
+                                   dup_hint=next(hints, None))
                 hashes[i] = self._tree_with_split(
                     TreeKind.FILE, files[i].name, meta,
                     [ref.hash for ref in manifest])
                 self.stats.files += 1
                 self.progress(file=str(files[i]), bytes=len(data))
+            self._flush_device_sync()
             batch_idx.clear()
             batch_data.clear()
             batch_meta.clear()
@@ -202,5 +242,6 @@ class DirPacker:
             dir_hash[d] = self._tree_with_split(TreeKind.DIR, name, meta,
                                                 children)
             self.stats.dirs += 1
+        self._flush_device_sync()
         self.writer.flush()
         return dir_hash[root]

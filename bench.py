@@ -45,6 +45,7 @@ def main() -> None:
 
     from backuwup_tpu.ops import cdc_cpu
     from backuwup_tpu.ops.blake3_cpu import Blake3Numpy
+    from backuwup_tpu.ops.cdc_tpu import _HALO
     from backuwup_tpu.ops.gear import CDCParams
     from backuwup_tpu.ops.pipeline import DevicePipeline
 
@@ -66,9 +67,10 @@ def main() -> None:
     cpu_chunks = cdc_cpu.chunk_stream(parity_bytes, params)
     cpu_digests = Blake3Numpy().digest_batch(
         [parity_bytes[o:o + l] for o, l in cpu_chunks])
-    dev_stream = jax.device_put(jnp.asarray(parity))
-    tpu_chunks, tpu_digests = pipeline.process_segment(
-        dev_stream, len(parity_bytes))
+    ext = np.concatenate([np.zeros(_HALO, dtype=np.uint8), parity])
+    (tpu_chunks, tpu_digests), = pipeline.manifest_resident_batch(
+        jnp.asarray(ext.reshape(1, -1)),
+        np.full(1, len(parity_bytes), dtype=np.int32))
     tpu_digest_bytes = [bytes(d) for d in tpu_digests]
     if tpu_chunks != cpu_chunks or tpu_digest_bytes != cpu_digests:
         print(json.dumps({"metric": "chunk+hash parity FAILED", "value": 0.0,
@@ -77,23 +79,29 @@ def main() -> None:
     dedup = len(set(cpu_digests)) / len(cpu_digests)
     log(f"parity OK: {len(cpu_chunks)} chunks, unique-ratio {dedup:.3f}")
 
-    # --- TPU timing: device-synthesized resident segments ------------------
+    # --- TPU timing: device-synthesized resident batches -------------------
+    # Times pipeline.manifest_resident_batch — the exact device core the
+    # engine's backup path runs per file batch (TpuBackend.manifest_many).
     key = jax.random.PRNGKey(0)
+    row = _HALO + seg_bytes
+    nv = np.full(1, seg_bytes, dtype=np.int32)
 
     @jax.jit
     def synth(key):
-        return jax.random.randint(key, (seg_bytes,), 0, 256, dtype=jnp.uint8)
+        seg = jax.random.randint(key, (seg_bytes,), 0, 256, dtype=jnp.uint8)
+        return jnp.concatenate([jnp.zeros(_HALO, dtype=jnp.uint8), seg]
+                               ).reshape(1, row)
 
     # warm (compile everything once)
-    stream = synth(key)
-    pipeline.process_segment(stream, seg_bytes)
+    pipeline.manifest_resident_batch(synth(key), nv, strict_overflow=True)
 
     t0 = time.time()
     total_chunks = 0
     for i in range(segments):
         key, sub = jax.random.split(key)
-        stream = synth(sub)
-        chunks, digests = pipeline.process_segment(stream, seg_bytes)
+        buf = synth(sub)
+        (chunks, digests), = pipeline.manifest_resident_batch(
+            buf, nv, strict_overflow=True)
         total_chunks += len(chunks)
     tpu_s = time.time() - t0
     tpu_mibs = segments * seg_mib / tpu_s

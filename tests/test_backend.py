@@ -1,0 +1,74 @@
+"""Backend-level parity: the production TPU manifest path vs the CPU oracle.
+
+The device-resident batch path (`DevicePipeline.manifest_batch` behind
+`TpuBackend.manifest_many`) must produce bit-identical chunk boundaries and
+digests to `CpuBackend` — dedup ratios depend on it (SURVEY.md section 7
+hard part 1).
+"""
+
+import random
+
+import pytest
+
+from backuwup_tpu.ops.backend import CpuBackend, TpuBackend, select_backend
+from backuwup_tpu.ops.gear import CDCParams
+
+PARAMS = CDCParams.from_desired(4096)
+
+
+def _assert_manifests_equal(a, b):
+    assert len(a) == len(b)
+    for ma, mb in zip(a, b):
+        assert [(r.offset, r.length, r.hash) for r in ma] == \
+            [(r.offset, r.length, r.hash) for r in mb]
+
+
+@pytest.fixture(scope="module")
+def backends():
+    return CpuBackend(PARAMS), TpuBackend(PARAMS)
+
+
+def test_manifest_many_parity_mixed_sizes(backends, rng=random.Random(5)):
+    cpu, tpu = backends
+    streams = [
+        b"",                       # empty file
+        b"x",                      # single byte
+        rng.randbytes(100),        # < min_size (single runt chunk)
+        rng.randbytes(PARAMS.min_size),          # exactly min
+        rng.randbytes(5000),
+        rng.randbytes(65536),      # exactly one segment bucket
+        rng.randbytes(65537),      # just over a bucket boundary
+        rng.randbytes(200_000),    # multi-chunk
+        b"\x00" * 50_000,          # no candidates -> max-size forced cuts
+        rng.randbytes(60_000) * 2,  # internal duplication
+    ]
+    _assert_manifests_equal(cpu.manifest_many(streams),
+                            tpu.manifest_many(streams))
+
+
+def test_manifest_many_parity_large_batch(backends, rng=random.Random(6)):
+    """Many small files of one bucket — the vmapped batch dispatch."""
+    cpu, tpu = backends
+    streams = [rng.randbytes(rng.randrange(1, 30_000)) for _ in range(64)]
+    _assert_manifests_equal(cpu.manifest_many(streams),
+                            tpu.manifest_many(streams))
+
+
+def test_manifest_stream_matches_manifest(backends, rng=random.Random(7)):
+    cpu, tpu = backends
+    data = rng.randbytes(300_000)
+    pos = [0]
+
+    def read(n):
+        out = data[pos[0]:pos[0] + n]
+        pos[0] += n
+        return out
+
+    refs = tpu.manifest_stream(read, segment_bytes=64 * 1024)
+    assert [(r.offset, r.length, r.hash) for r in refs] == \
+        [(r.offset, r.length, r.hash) for r in cpu.manifest(data)]
+
+
+def test_select_backend_policy():
+    assert select_backend("cpu").name == "cpu"
+    assert select_backend("tpu").name == "tpu"

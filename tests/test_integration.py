@@ -98,6 +98,76 @@ def test_two_client_backup_restore_cycle(tmp_path, loop):
     loop.run_until_complete(asyncio.wait_for(run(), 180))
 
 
+def test_two_client_cycle_device_backend_and_mesh_dedup(tmp_path, loop):
+    """The same backup->disaster->restore cycle with the production device
+    pipeline (TpuBackend resident batches) and dedup decisions routed
+    through the sharded HBM index on the 8-device mesh, host BlobIndex
+    parity asserted throughout (VERDICT round-1 item 2)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from backuwup_tpu.ops.backend import TpuBackend
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    rng = random.Random(1234)
+    src_a = tmp_path / "a_src"
+    src_b = tmp_path / "b_src"
+    src_a.mkdir()
+    src_b.mkdir()
+    files_a = _corpus(src_a, rng, "a")
+    _corpus(src_b, rng, "b")
+
+    async def run():
+        server = CoordinationServer(db_path=str(tmp_path / "server.db"))
+        port = await server.start()
+        addr = f"127.0.0.1:{port}"
+
+        def make_app(name):
+            return ClientApp(config_dir=tmp_path / name / "cfg",
+                             data_dir=tmp_path / name / "data",
+                             server_addr=addr, backend=TpuBackend(SMALL),
+                             dedup_mesh=mesh)
+
+        a = make_app("a")
+        b = make_app("b")
+        await a.start()
+        await b.start()
+        a.store.set_backup_path(str(src_a))
+        b.store.set_backup_path(str(src_b))
+
+        snap_a, snap_b = await asyncio.wait_for(
+            asyncio.gather(a.backup(), b.backup()), 300)
+        assert len(snap_a) == 32 and len(snap_b) == 32
+        assert a.engine.device_dedup is not None
+        # the dup.bin corpus file repeats a 60k block: dedup must have fired
+        # on the very first backup (device-routed classification)
+        assert a.engine.last_pack_stats.chunks_deduped > 0
+
+        shutil.rmtree(src_a)
+        dest = tmp_path / "a_restored"
+        restored = await asyncio.wait_for(a.restore(dest), 120)
+        for rel, data in files_a.items():
+            assert (restored / rel).read_bytes() == data, rel
+
+        # incremental re-backup: identical content, so the device-routed
+        # dedup must classify every chunk duplicate (the snapshot id itself
+        # changes — tree metadata carries fresh ctimes)
+        for rel, data in files_a.items():
+            (src_a / rel).parent.mkdir(parents=True, exist_ok=True)
+            (src_a / rel).write_bytes(data)
+        await asyncio.wait_for(a.backup(), 300)
+        stats = a.engine.last_pack_stats
+        assert stats.chunks > 0
+        assert stats.chunks_deduped >= stats.chunks
+
+        await a.stop()
+        await b.stop()
+        await server.stop()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 600))
+
+
 def test_backup_resumes_after_interrupted_send(tmp_path, loop):
     """Packfiles that never got acked stay local and are re-sent by the next
     backup run (send.rs:82-92 semantics)."""
