@@ -1,0 +1,123 @@
+"""ctypes binding for the native single-thread dedup pipeline.
+
+``libbkw_native.so`` (built by the Makefile here) plays the role of the
+reference's native `fastcdc` + SIMD `blake3` crates
+(``dir_packer.rs:246-311``): the honest single-thread CPU baseline for the
+device pipeline's throughput target, and a fast host fallback.  The library
+is built on first import when a C compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_DIR = Path(__file__).resolve().parent
+_LIB = _DIR / "libbkw_native.so"
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> None:
+    subprocess.run(["make", "-C", str(_DIR), "-s"], check=True,
+                   capture_output=True)
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if needed) the native library; raises
+    :class:`NativeUnavailable` when no compiler/library exists."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _LIB.exists():
+        try:
+            _build()
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise NativeUnavailable(f"cannot build native library: {e}")
+    lib = ctypes.CDLL(str(_LIB))
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.bkw_blake3.argtypes = [u8p, ctypes.c_size_t, u8p]
+    lib.bkw_blake3.restype = None
+    common = [u8p, ctypes.c_size_t, ctypes.c_uint64, ctypes.c_uint64,
+              ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32, u64p, u64p]
+    lib.bkw_chunk.argtypes = common + [ctypes.c_size_t]
+    lib.bkw_chunk.restype = ctypes.c_long
+    lib.bkw_manifest.argtypes = common + [u8p, ctypes.c_size_t]
+    lib.bkw_manifest.restype = ctypes.c_long
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def _u8(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def blake3_native(data: bytes) -> bytes:
+    lib = load()
+    arr = np.frombuffer(bytes(data), dtype=np.uint8)
+    out = np.zeros(32, dtype=np.uint8)
+    lib.bkw_blake3(_u8(arr) if len(arr) else _u8(out), len(arr), _u8(out))
+    return out.tobytes()
+
+
+def _cap(n: int, min_size: int) -> int:
+    return max(4, n // max(min_size, 1) + 2)
+
+
+def chunk_native(data, params) -> List[Tuple[int, int]]:
+    """Chunk one stream; bit-identical to ops.cdc_cpu.chunk_stream."""
+    lib = load()
+    arr = np.frombuffer(bytes(data), dtype=np.uint8)
+    cap = _cap(len(arr), params.min_size)
+    offs = np.zeros(cap, dtype=np.uint64)
+    lens = np.zeros(cap, dtype=np.uint64)
+    k = lib.bkw_chunk(
+        _u8(arr) if len(arr) else _u8(offs.view(np.uint8)), len(arr),
+        params.min_size, params.desired_size, params.max_size,
+        params.mask_s, params.mask_l,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), cap)
+    if k < 0:
+        raise RuntimeError("native chunk capacity overflow")
+    return [(int(offs[i]), int(lens[i])) for i in range(k)]
+
+
+def manifest_native(data, params):
+    """Chunk + digest one stream single-threaded; returns
+    (chunks, digests-bytes-list)."""
+    lib = load()
+    arr = np.frombuffer(bytes(data), dtype=np.uint8)
+    cap = _cap(len(arr), params.min_size)
+    offs = np.zeros(cap, dtype=np.uint64)
+    lens = np.zeros(cap, dtype=np.uint64)
+    digs = np.zeros(cap * 32, dtype=np.uint8)
+    k = lib.bkw_manifest(
+        _u8(arr) if len(arr) else _u8(digs), len(arr),
+        params.min_size, params.desired_size, params.max_size,
+        params.mask_s, params.mask_l,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        _u8(digs), cap)
+    if k < 0:
+        raise RuntimeError("native manifest capacity overflow")
+    chunks = [(int(offs[i]), int(lens[i])) for i in range(k)]
+    digests = [digs[32 * i:32 * (i + 1)].tobytes() for i in range(k)]
+    return chunks, digests

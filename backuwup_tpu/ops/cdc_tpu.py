@@ -256,6 +256,51 @@ class TpuCdcScanner:
 
 
 # ---------------------------------------------------------------------------
+# Batched scan with single-transfer sparse output: the CDC candidate front
+# end for whole file batches, one dispatch + ONE device->host download.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("mask_s", "mask_l", "k_cap"))
+def scan_words_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray,
+                     *, mask_s: int, mask_l: int,
+                     k_cap: int) -> jnp.ndarray:
+    """``(B, _HALO+P) u8 -> (B, 1+3*k_cap) i32`` packed sparse candidates.
+
+    Per row: ``[nz_words, widx..., words_l..., words_s...]`` — the same
+    two-level sparse structure as :func:`_scan_segment`, but all outputs
+    packed into ONE array so a whole batch costs a single device->host
+    transfer (the relay-attached dev rig pays ~100 ms per transfer; real
+    PCIe pays per-transfer latency too, just less).  Host-side cut
+    selection then runs the oracle's ``select_cuts`` verbatim.
+    """
+    ms = jnp.uint32(mask_s)  # static -> folded constants, no upload
+    ml = jnp.uint32(mask_l)
+
+    def one(ext, n):
+        h = _hash_ext_fast(ext)
+        words_l, words_s = _candidate_words(h, n, ms, ml)
+        nz = words_l != 0
+        (widx,) = jnp.nonzero(nz, size=k_cap, fill_value=-1)
+        nz_words = jnp.sum(nz.astype(jnp.int32))
+        safe = jnp.clip(widx, 0, words_l.shape[0] - 1)
+        return jnp.concatenate([
+            nz_words[None], widx.astype(jnp.int32),
+            words_l[safe].astype(jnp.int32), words_s[safe].astype(jnp.int32)])
+
+    return jax.vmap(one)(ext_b, nv_b)
+
+
+def unpack_scan_words(row, k_cap: int):
+    """One packed row -> (nz_words, widx, wl(u32), ws(u32)) numpy views."""
+    nz = int(row[0])
+    widx = row[1:1 + k_cap]
+    wl = row[1 + k_cap:1 + 2 * k_cap].astype(np.int64).astype(np.uint32)
+    ws = row[1 + 2 * k_cap:1 + 3 * k_cap].astype(np.int64).astype(np.uint32)
+    return nz, widx, wl, ws
+
+
+# ---------------------------------------------------------------------------
 # Sharded long-stream scan: blockwise over a device mesh, halo over ICI.
 # ---------------------------------------------------------------------------
 
