@@ -10,6 +10,7 @@ is built on first import when a C compiler is available.
 from __future__ import annotations
 
 import ctypes
+import logging
 import subprocess
 from pathlib import Path
 from typing import List, Optional, Tuple
@@ -32,17 +33,30 @@ def _build() -> None:
                    capture_output=True)
 
 
+def _stale() -> bool:
+    if not _LIB.exists():
+        return True
+    mtime = _LIB.stat().st_mtime
+    return any(src.stat().st_mtime > mtime
+               for src in (_DIR / "cdc_blake3.c", _DIR / "Makefile")
+               if src.exists())
+
+
 def load() -> ctypes.CDLL:
-    """Load (building if needed) the native library; raises
+    """Load (building if missing or stale) the native library; raises
     :class:`NativeUnavailable` when no compiler/library exists."""
     global _lib
     if _lib is not None:
         return _lib
-    if not _LIB.exists():
+    if _stale():
         try:
             _build()
         except (OSError, subprocess.CalledProcessError) as e:
-            raise NativeUnavailable(f"cannot build native library: {e}")
+            if not _LIB.exists():
+                raise NativeUnavailable(f"cannot build native library: {e}")
+            logging.getLogger(__name__).warning(
+                "native library is stale and rebuild failed (%s); "
+                "loading the outdated binary", e)
     lib = ctypes.CDLL(str(_LIB))
     u8p = ctypes.POINTER(ctypes.c_uint8)
     u64p = ctypes.POINTER(ctypes.c_uint64)

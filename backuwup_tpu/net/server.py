@@ -86,6 +86,18 @@ class ServerDB:
             (source, destination, size, time.time()))
         self._db.commit()
 
+    def delete_storage_negotiated(self, source: bytes, destination: bytes,
+                                  size: int) -> None:
+        """Roll back one just-recorded negotiation (failed-push compensation
+        in StorageQueue.fulfill)."""
+        self._db.execute(
+            "DELETE FROM peer_backups WHERE rowid = ("
+            " SELECT rowid FROM peer_backups WHERE source = ?"
+            " AND destination = ? AND size_negotiated = ?"
+            " ORDER BY timestamp DESC LIMIT 1)",
+            (source, destination, size))
+        self._db.commit()
+
     def save_snapshot(self, pubkey: bytes, snapshot_hash: bytes) -> None:
         self._db.execute(
             "INSERT INTO snapshots (client_pubkey, snapshot_hash, timestamp)"
@@ -204,34 +216,44 @@ class StorageQueue:
                 if candidate == bytes(client_id):
                     continue  # self-match discarded
                 match = min(remaining, cand_remaining)
-                # Notify candidate first; only record the negotiation once
-                # both pushes actually landed — a client must never be
-                # listed as a restore peer without having learned of the
-                # match (backup_request.rs:95-139 records after notify).
+                # Record the negotiation FIRST, then push: a client must
+                # never learn of a match the server does not persist (a
+                # notified candidate would start treating the requester as a
+                # negotiated peer while get_client_negotiated_peers denies
+                # it).  A failed candidate push rolls the record back; the
+                # reference instead records after notify
+                # (backup_request.rs:95-139) and carries that window.
+                self.db.save_storage_negotiated(bytes(client_id), candidate,
+                                                match)
+                self.db.save_storage_negotiated(candidate, bytes(client_id),
+                                                match)
                 ok_cand = await self.connections.notify(
                     candidate, wire.BackupMatched(
                         destination_id=bytes(client_id),
                         storage_available=match))
                 if not ok_cand:
-                    # Candidate unreachable: drop its queued request and try
-                    # the next one (backup_request.rs:166-173).
+                    # Candidate unreachable: roll back, drop its queued
+                    # request, and try the next one
+                    # (backup_request.rs:166-173).
+                    self.db.delete_storage_negotiated(
+                        bytes(client_id), candidate, match)
+                    self.db.delete_storage_negotiated(
+                        candidate, bytes(client_id), match)
                     continue
                 ok_self = await self.connections.notify(
                     bytes(client_id), wire.BackupMatched(
                         destination_id=candidate, storage_available=match))
                 if not ok_self:
-                    # The requester itself is unreachable: stop matching
-                    # entirely instead of draining the queue with matches
-                    # nobody records.  Re-enqueue the candidate (who was
-                    # notified of a match we won't record — it will re-request
-                    # on its own retry cadence) and discard the requester.
-                    self._queue.append((candidate, cand_remaining,
-                                        cand_expires))
+                    # The requester is unreachable but the candidate has
+                    # already been told: keep the record (both sides stay
+                    # consistent; the requester discovers the peer on its
+                    # next restore/reconnect), re-enqueue the candidate's
+                    # remainder, and stop matching for the dead requester.
+                    cand_remaining -= match
+                    if cand_remaining > 0:
+                        self._queue.append((candidate, cand_remaining,
+                                            cand_expires))
                     return
-                self.db.save_storage_negotiated(bytes(client_id), candidate,
-                                                match)
-                self.db.save_storage_negotiated(candidate, bytes(client_id),
-                                                match)
                 remaining -= match
                 cand_remaining -= match
                 if cand_remaining > 0:

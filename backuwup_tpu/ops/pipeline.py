@@ -176,9 +176,13 @@ class DevicePipeline:
             # so arbitrary per-directory batch sizes reuse a handful of
             # compiled shapes
             max_rows = max(1, _SCAN_DISPATCH_BYTES // row)
+            # pow2 row padding, clamped by the dispatch budget (largest
+            # pow2 <= max_rows): a lone 128 MiB stream must not balloon
+            # to 8 identical rows
+            b_cap = 1 << (max_rows.bit_length() - 1)
             for s0 in range(0, len(idxs), max_rows):
                 part = idxs[s0:s0 + max_rows]
-                B = 8
+                B = min(8, b_cap)
                 while B < len(part):
                     B *= 2
                 buf = np.zeros((B, row), dtype=np.uint8)

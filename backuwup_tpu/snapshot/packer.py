@@ -19,6 +19,7 @@ Re-designs ``client/src/backup/filesystem/dir_packer.rs``:
 
 from __future__ import annotations
 
+import logging
 import os
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -41,6 +42,7 @@ class PackStats:
     chunks: int = 0
     chunks_deduped: int = 0
     bytes_deduped: int = 0
+    dedup_divergences: int = 0
 
 
 class DirPacker:
@@ -69,15 +71,19 @@ class DirPacker:
         """Dedup-then-pack one blob (pack.rs:31-55 semantics).
 
         ``dup_hint`` is the device table's classification when the blob was
-        part of a batched classify; host and device must agree — a mismatch
-        means the two dedup authorities diverged, which would corrupt the
-        incremental-backup story, so it fails loudly.
+        part of a batched classify.  The host index is the authority: on
+        disagreement the host verdict wins and the event is logged loudly —
+        device=dup/host=new is expected-by-design (astronomically rare
+        128-bit truncation collisions in the device table's key prefix,
+        see device_dedup.py), and degrading beats failing the whole backup.
         """
         host_dup = self.index.is_duplicate(blob_hash)
         if dup_hint is not None and dup_hint != host_dup:
-            raise RuntimeError(
-                f"device/host dedup divergence on {bytes(blob_hash).hex()}: "
-                f"device={dup_hint} host={host_dup}")
+            self.stats.dedup_divergences += 1
+            logging.getLogger(__name__).warning(
+                "device/host dedup divergence on %s: device=%s host=%s; "
+                "using host verdict", bytes(blob_hash).hex(), dup_hint,
+                host_dup)
         if dup_hint is None and self.dedup_batch is not None:
             # blob classified host-side only (tree node or streamed chunk):
             # sync it into the device table at the next batch boundary

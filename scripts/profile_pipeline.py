@@ -1,0 +1,183 @@
+"""Stage-by-stage profiler for the device-resident dedup pipeline.
+
+Times, with hard device syncs between stages, on a BENCH-shaped segment:
+  0. trivial-dispatch latency (the relay-tunnel floor)
+  1. scan_words_batch dispatch + download
+  2. host cut selection over the sparse words
+  3. flat pad + per-bucket _gather_digest dispatches
+  4. final digest download
+Prints a per-stage table so the optimization attacks measured cost, not
+guessed cost.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from backuwup_tpu.utils.jaxcache import enable_compilation_cache
+
+enable_compilation_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from backuwup_tpu.ops.cdc_cpu import cuts_to_chunks, select_cuts
+from backuwup_tpu.ops.cdc_tpu import _HALO, scan_words_batch, unpack_scan_words
+from backuwup_tpu.ops.gear import CDCParams
+from backuwup_tpu.ops.pipeline import CHUNK_LEN, DevicePipeline, _gather_digest, _pad_to
+
+SEG_MIB = int(os.environ.get("PROF_SEGMENT_MIB", "128"))
+REPS = int(os.environ.get("PROF_REPS", "3"))
+
+
+def sync():
+    jax.block_until_ready(jnp.zeros(1))
+
+
+def main():
+    params = CDCParams()
+    pipe = DevicePipeline(params)
+    seg_bytes = SEG_MIB << 20
+    row = _HALO + seg_bytes
+    print(f"devices: {jax.devices()}", flush=True)
+
+    @jax.jit
+    def synth(key):
+        seg = jax.random.randint(key, (seg_bytes,), 0, 256, dtype=jnp.uint8)
+        return jnp.concatenate([jnp.zeros(_HALO, dtype=jnp.uint8), seg]).reshape(1, row)
+
+    key = jax.random.PRNGKey(7)
+    nv = np.full(1, seg_bytes, dtype=np.int32)
+    nv_d = jnp.asarray(nv)
+
+    # measure trivial dispatch latency
+    tiny = jax.jit(lambda x: x + 1)
+    tiny(jnp.zeros(8)).block_until_ready()
+    t0 = time.time()
+    for _ in range(10):
+        tiny(jnp.zeros(8)).block_until_ready()
+    disp = (time.time() - t0) / 10
+    print(f"trivial dispatch+sync: {disp*1e3:.1f} ms", flush=True)
+
+    # tiny download latency
+    x = jnp.zeros(8)
+    jax.block_until_ready(x)
+    t0 = time.time()
+    for _ in range(10):
+        np.asarray(tiny(x))
+    dl = (time.time() - t0) / 10
+    print(f"tiny roundtrip (dispatch+download): {dl*1e3:.1f} ms", flush=True)
+
+    # warm everything once via the production path
+    key, sub = jax.random.split(key)
+    buf = synth(sub)
+    jax.block_until_ready(buf)
+    pipe.manifest_resident_batch(buf, nv, strict_overflow=True)
+
+    k_cap = pipe.scanner._k_cap(seg_bytes)
+    print(f"k_cap={k_cap}", flush=True)
+
+    for rep in range(REPS):
+        key, sub = jax.random.split(key)
+        t0 = time.time()
+        buf = synth(sub)
+        jax.block_until_ready(buf)
+        t_synth = time.time() - t0
+
+        # stage 1: scan dispatch (device only)
+        t0 = time.time()
+        packed_d = scan_words_batch(buf, nv_d, mask_s=params.mask_s,
+                                    mask_l=params.mask_l, k_cap=k_cap)
+        jax.block_until_ready(packed_d)
+        t_scan = time.time() - t0
+
+        # stage 1b: download of packed words
+        t0 = time.time()
+        packed = np.asarray(packed_d)
+        t_dl1 = time.time() - t0
+
+        # stage 2: host cut selection
+        t0 = time.time()
+        from backuwup_tpu.ops.cdc_tpu import _decode_words
+        nz, widx, wl, ws = unpack_scan_words(packed[0], k_cap)
+        assert nz <= k_cap
+        pos_l, is_s = _decode_words(widx, wl, ws, k_cap, 0)
+        chunks = cuts_to_chunks(select_cuts(pos_l[is_s], pos_l, seg_bytes, params))
+        t_cut = time.time() - t0
+
+        # stage 3: flat pad
+        t0 = time.time()
+        span_max = pipe.l_bucket * CHUNK_LEN
+        flat = jnp.pad(buf.reshape(-1), (0, span_max))
+        jax.block_until_ready(flat)
+        t_pad = time.time() - t0
+
+        # stage 3b: bucket + gather_digest dispatches
+        t0 = time.time()
+        groups = {}
+        for ci, (off, ln) in enumerate(chunks):
+            groups.setdefault(pipe._chunk_bucket(ln), []).append((_HALO + off, ln, 0, ci))
+        buckets = []
+        offs_parts, lens_parts = [], []
+        start = 0
+        for Lb, items in sorted(groups.items()):
+            for s0 in range(0, len(items), pipe.b_bucket):
+                part = items[s0:s0 + pipe.b_bucket]
+                Bb = 8
+                while Bb < len(part):
+                    Bb *= 2
+                o = np.zeros(Bb, dtype=np.int32)
+                ln_arr = np.zeros(Bb, dtype=np.int32)
+                for q, (off, ln, _r, _ci) in enumerate(part):
+                    o[q] = off
+                    ln_arr[q] = ln
+                offs_parts.append(o)
+                lens_parts.append(ln_arr)
+                buckets.append((start, Bb, Lb, None))
+                start += Bb
+        starts = np.array([st for st, _b, _l, _t in buckets], dtype=np.int32)
+        total = 256
+        while total < max(start, len(starts)):
+            total *= 2
+        meta = jnp.asarray(np.stack([
+            _pad_to(np.concatenate(offs_parts), total),
+            _pad_to(np.concatenate(lens_parts), total),
+            _pad_to(starts, total)]))
+        acc = jnp.zeros((total, 8), dtype=jnp.uint32)
+        jax.block_until_ready(meta)
+        t_meta = time.time() - t0
+
+        t0 = time.time()
+        for i, (_st, Bb, Lb, _tags) in enumerate(buckets):
+            acc = _gather_digest(flat, meta, meta[2, i], acc, B=Bb, L=Lb)
+        jax.block_until_ready(acc)
+        t_dig = time.time() - t0
+
+        t0 = time.time()
+        allcv = np.asarray(acc)
+        t_dl2 = time.time() - t0
+
+        tot = t_scan + t_dl1 + t_cut + t_pad + t_meta + t_dig + t_dl2
+        print(f"rep{rep}: synth={t_synth*1e3:7.1f}  scan={t_scan*1e3:7.1f}  "
+              f"dl1={t_dl1*1e3:6.1f}  cut={t_cut*1e3:6.1f}  pad={t_pad*1e3:6.1f}  "
+              f"meta={t_meta*1e3:6.1f}  digest={t_dig*1e3:7.1f} ({len(buckets)} buckets, "
+              f"{len(chunks)} chunks)  dl2={t_dl2*1e3:6.1f}  "
+              f"TOTAL={tot*1e3:7.1f} ms -> {SEG_MIB/tot:6.1f} MiB/s", flush=True)
+
+    print("\nper-(B,L) single-dispatch timings:", flush=True)
+    for (st, Bb, Lb, _t) in buckets[:6]:
+        t0 = time.time()
+        acc = _gather_digest(flat, meta, meta[2, 0], acc, B=Bb, L=Lb)
+        jax.block_until_ready(acc)
+        t1 = time.time() - t0
+        print(f"  B={Bb:4d} L={Lb:5d} ({Bb*Lb/1024:7.1f} MiB padded): {t1*1e3:7.1f} ms "
+              f"-> {Bb*Lb/1024/t1:7.1f} MiB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -102,8 +102,9 @@ def test_storage_request_matching(tmp_path, loop):
 
 def test_matcher_requester_offline_does_not_drain_queue(loop):
     """If the requester's push fails mid-fulfill, matching must stop:
-    candidates stay queued (re-enqueued) and nothing is recorded, instead
-    of popping every candidate with matches nobody records."""
+    the already-notified candidate's match stays recorded (a client is
+    never notified of a match the server does not persist), and the
+    remaining candidates are never popped."""
     from backuwup_tpu.net.server import ServerDB, StorageQueue
 
     req = b"\x0a" * 32
@@ -126,10 +127,12 @@ def test_matcher_requester_offline_does_not_drain_queue(loop):
         q._queue.append((c, 50 * 1000 * 1000, _time.time() + 300))
 
     loop.run_until_complete(q.fulfill(req, 150 * 1000 * 1000))
-    # first candidate was re-enqueued, the others never popped
-    assert q.pending() == 3
-    assert db.get_client_negotiated_peers(req) == []
-    for c in cands:
+    # the first candidate was fully matched (and notified, so the record
+    # stays); the other two were never popped
+    assert q.pending() == 2
+    assert db.get_client_negotiated_peers(req) == [cands[0]]
+    assert db.get_client_negotiated_peers(cands[0]) == [req]
+    for c in cands[1:]:
         assert db.get_client_negotiated_peers(c) == []
 
 

@@ -88,7 +88,10 @@ def test_streamed_chunks_synced_before_next_classify(mesh, tmp_path):
     packer = DirPacker(CpuBackend(params), writer, index,
                        batch_bytes=100_000,
                        dedup_batch=dev.classify_insert)
-    packer.pack(src)  # raises RuntimeError divergence if sync order wrong
+    packer.pack(src)
+    # wrong sync order shows up as device/host divergences (host wins,
+    # logged + counted)
+    assert packer.stats.dedup_divergences == 0
     assert packer.stats.chunks_deduped > 0
 
 
