@@ -178,10 +178,11 @@ class DevicePipeline:
             max_rows = max(1, _SCAN_DISPATCH_BYTES // row)
             # pow2 row padding, clamped by the dispatch budget (largest
             # pow2 <= max_rows): a lone 128 MiB stream must not balloon
-            # to 8 identical rows
+            # to 8 identical rows, and a full part must not double past
+            # the budget — so slice by the pow2 cap itself
             b_cap = 1 << (max_rows.bit_length() - 1)
-            for s0 in range(0, len(idxs), max_rows):
-                part = idxs[s0:s0 + max_rows]
+            for s0 in range(0, len(idxs), b_cap):
+                part = idxs[s0:s0 + b_cap]
                 B = min(8, b_cap)
                 while B < len(part):
                     B *= 2

@@ -1,0 +1,63 @@
+"""Parity of the native C baseline against the CPU oracle.
+
+``native/cdc_blake3.c`` is the honest single-thread CPU baseline the device
+pipeline is benchmarked against (BASELINE.md targets); its bit-identity with
+the spec implementations (`ops/cdc_cpu.py`, `ops/blake3_cpu.py`) is asserted
+here over the same corpus shapes `test_backend.py` uses for the TPU path.
+"""
+
+import random
+
+import pytest
+
+from backuwup_tpu import native
+from backuwup_tpu.ops import cdc_cpu
+from backuwup_tpu.ops.blake3_cpu import blake3_hash
+from backuwup_tpu.ops.gear import CDCParams
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C compiler / native lib")
+
+PARAMS = CDCParams.from_desired(4096)
+
+
+def _corpus(rng):
+    return [
+        b"",
+        b"x",
+        rng.randbytes(100),                     # < min (single runt chunk)
+        rng.randbytes(PARAMS.min_size),         # exactly min
+        rng.randbytes(5000),
+        rng.randbytes(65536),
+        rng.randbytes(65537),
+        rng.randbytes(200_000),                 # multi-chunk
+        b"\x00" * 50_000,                       # no candidates -> max cuts
+        rng.randbytes(60_000) * 2,              # internal duplication
+    ]
+
+
+def test_blake3_native_parity(rng=random.Random(11)):
+    for n in (0, 1, 63, 64, 65, 1023, 1024, 1025, 2048, 4097, 10_000,
+              65_536, 200_001):
+        data = rng.randbytes(n)
+        assert native.blake3_native(data) == blake3_hash(data), n
+
+
+def test_chunk_native_parity(rng=random.Random(12)):
+    for data in _corpus(rng):
+        assert native.chunk_native(data, PARAMS) == \
+            cdc_cpu.chunk_stream(data, PARAMS), len(data)
+
+
+def test_chunk_native_parity_production_params(rng=random.Random(13)):
+    params = CDCParams()  # production 256 KiB / 1 MiB / 3 MiB
+    data = rng.randbytes(8 << 20)
+    assert native.chunk_native(data, params) == \
+        cdc_cpu.chunk_stream(data, params)
+
+
+def test_manifest_native_parity(rng=random.Random(14)):
+    for data in _corpus(rng):
+        chunks, digests = native.manifest_native(data, PARAMS)
+        assert chunks == cdc_cpu.chunk_stream(data, PARAMS)
+        assert digests == [blake3_hash(data[o:o + l]) for o, l in chunks]
