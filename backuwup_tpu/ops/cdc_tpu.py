@@ -87,23 +87,25 @@ def _hash_ext(ext: jnp.ndarray, halo_len: jnp.ndarray) -> jnp.ndarray:
 def _hash_ext_fast(ext: jnp.ndarray) -> jnp.ndarray:
     """Per-position hashes for ``ext[_HALO:]``, production path.
 
-    ``lax.fori_loop`` over the 32 taps keeps peak memory at ~3 stream-sized
-    u32 buffers regardless of segment length.  No warmup masking: the caller
-    zero-fills the halo at a stream start, which perturbs only h[0..30] —
-    positions that can never be selected as cuts because every cut-selection
-    window starts at >= min_size - 1 > 31 (CDC_SPEC.md; min_size >= 64).
-    Candidate *sets* may therefore contain sub-min positions the CPU oracle
-    lacks, but selected cuts are bit-identical.
+    The 32-tap windowed sum is evaluated by **log-doubling** the linear
+    recurrence: after pass ``t`` the running array holds
+    ``a_t[i] = sum_{k < 2^t} GEAR[b[i-k]] << k``, so five shift-adds
+    (``a <- a + (a >> shift 2^t positions) << 2^t``) replace 32 taps —
+    ~8x less HBM traffic than a 32-iteration fori_loop.  Positions shifted
+    in from beyond the left edge of ``ext`` read zero, which matches the
+    zero-filled-halo warmup contract: at a stream start only h[0..30] are
+    perturbed, positions that can never be selected as cuts because every
+    cut-selection window starts at >= min_size - 1 > 31 (CDC_SPEC.md;
+    min_size >= 64).  Candidate *sets* may therefore contain sub-min
+    positions the CPU oracle lacks, but selected cuts are bit-identical.
     """
-    g = _gear_values(ext)
-    L = ext.shape[0] - _HALO
-
-    def body(k, h):
-        seg = jax.lax.dynamic_slice(g, (_HALO - k,), (L,))
-        return h + (seg << k.astype(jnp.uint32))
-
-    # k=0 term seeds the carry (also gives it the right vma under shard_map)
-    return jax.lax.fori_loop(1, GEAR_WINDOW, body, g[_HALO:])
+    assert GEAR_WINDOW == 32, "doubling ladder assumes a 32-byte window"
+    a = _gear_values(ext)
+    for t in range(5):
+        s = 1 << t
+        shifted = jnp.concatenate([jnp.zeros(s, dtype=a.dtype), a[:-s]])
+        a = a + (shifted << jnp.uint32(s))
+    return a[_HALO:]
 
 
 def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
@@ -289,6 +291,79 @@ def scan_words_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray,
             words_l[safe].astype(jnp.int32), words_s[safe].astype(jnp.int32)])
 
     return jax.vmap(one)(ext_b, nv_b)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "min_size", "desired_size", "max_size", "mask_s", "mask_l",
+    "s_cap", "l_cap", "cut_cap"))
+def scan_select_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
+                      min_size: int, desired_size: int, max_size: int,
+                      mask_s: int, mask_l: int,
+                      s_cap: int, l_cap: int, cut_cap: int) -> jnp.ndarray:
+    """Fused gear scan + FastCDC cut selection, fully on device.
+
+    ``(B, _HALO+P) u8 -> (B, 2+cut_cap) i32`` packed per row as
+    ``[overflow, n_cuts, inclusive chunk end positions...]``.  This is the
+    whole CDC front end in ONE dispatch: hashes via the doubling ladder,
+    candidate compaction via fixed-capacity ``nonzero``, and the
+    min/desired/max two-mask greedy selection (bit-identical to
+    :func:`backuwup_tpu.ops.cdc_cpu.select_cuts`) as a ``lax.while_loop``
+    over the sparse candidates — so the only download a caller needs is the
+    tiny packed cut list, instead of candidate words plus a host selection
+    pass plus a chunk-meta re-upload.  ``overflow`` flags candidate counts
+    beyond the sparse capacity (adversarial data); such rows must be
+    re-chunked by the oracle.
+    """
+    P = ext_b.shape[1] - _HALO
+    ms = jnp.uint32(mask_s)
+    ml = jnp.uint32(mask_l)
+
+    def one(ext, n):
+        h = _hash_ext_fast(ext)
+        valid = jnp.arange(P, dtype=jnp.int32) < n
+        cand_l = ((h & ml) == 0) & valid
+        cand_s = cand_l & ((h & ms) == 0)
+        n_l = jnp.sum(cand_l.astype(jnp.int32))
+        n_s = jnp.sum(cand_s.astype(jnp.int32))
+        overflow = ((n_l > l_cap) | (n_s > s_cap)).astype(jnp.int32)
+        (pos_l,) = jnp.nonzero(cand_l, size=l_cap, fill_value=P)
+        (pos_s,) = jnp.nonzero(cand_s, size=s_cap, fill_value=P)
+        pos_l = pos_l.astype(jnp.int32)
+        pos_s = pos_s.astype(jnp.int32)
+
+        def cond(st):
+            s, k, _ = st
+            return s < n
+
+        def body(st):
+            s, k, cuts = st
+            # window 1: [min, desired) with the strict mask
+            lo = s + jnp.int32(min_size - 1)
+            hi = jnp.minimum(s + jnp.int32(desired_size - 2), n - 2)
+            i = jnp.searchsorted(pos_s, lo, side="left")
+            e1 = pos_s[jnp.minimum(i, s_cap - 1)]
+            ok1 = (i < s_cap) & (e1 <= hi)
+            # window 2: [desired, max) with the loose mask
+            lo2 = s + jnp.int32(desired_size - 1)
+            hi2 = jnp.minimum(s + jnp.int32(max_size - 2), n - 2)
+            j = jnp.searchsorted(pos_l, lo2, side="left")
+            e2 = pos_l[jnp.minimum(j, l_cap - 1)]
+            ok2 = (j < l_cap) & (e2 <= hi2)
+            # forced cut at max, or EOF
+            e = jnp.where(ok1, e1, jnp.where(
+                ok2, e2, jnp.minimum(s + jnp.int32(max_size - 1), n - 1)))
+            # short tail: everything left is one final chunk
+            e = jnp.where(n - s <= jnp.int32(min_size), n - 1, e)
+            cuts = cuts.at[k].set(e)
+            return e + 1, k + 1, cuts
+
+        s0 = jnp.int32(0)
+        k0 = jnp.int32(0)
+        cuts0 = jnp.full(cut_cap, -1, dtype=jnp.int32)
+        _, n_cuts, cuts = jax.lax.while_loop(cond, body, (s0, k0, cuts0))
+        return jnp.concatenate([overflow[None], n_cuts[None], cuts])
+
+    return jax.vmap(one)(ext_b, nv_b.astype(jnp.int32))
 
 
 def unpack_scan_words(row, k_cap: int):

@@ -1,14 +1,25 @@
-"""Device-resident dedup pipeline: scan -> cut -> gather chunks -> digest.
+"""Device-resident dedup pipeline: scan+select -> gather chunks -> digest.
 
 Composes the TPU kernels into the full chunk+hash step that ``bench.py``
 times and ``__graft_entry__.py`` exposes to the driver:
 
-1. gear-hash scan of a resident byte segment (:mod:`.cdc_tpu`),
-2. host cut selection over the sparse candidate words (tiny transfer),
-3. on-device gather of the variable-length chunks into a padded
-   ``(B, L*1024)`` batch (``vmap`` of ``dynamic_slice`` — bytes move
-   HBM->HBM, never through the host),
-4. batched BLAKE3 digests (:mod:`.blake3_tpu`).
+1. fused gear-hash scan + on-device FastCDC cut selection of a resident
+   byte batch (:func:`..ops.cdc_tpu.scan_select_batch`) — ONE dispatch,
+   and the only mid-pipeline download is the tiny packed cut list,
+2. on-device gather of the variable-length chunks into a small fixed set
+   of padded ``(B, L*1024)`` tiles (``vmap`` of ``dynamic_slice`` — bytes
+   move HBM->HBM, never through the host),
+3. batched BLAKE3 digests (:mod:`.blake3_tpu`).
+
+Tile shapes are restricted to B in {8, 32, 128} and pow2 leaf buckets so
+the whole pipeline compiles a small closed set of programs (first-run cost,
+then the persistent cache) — data-dependent shapes were the round-2
+throughput killer: every novel (B, L) combo paid a 20-40 s XLA compile.
+
+Dispatch and collect halves are separate methods so
+:meth:`DevicePipeline.manifest_segments` can software-pipeline several
+segments: segment i+1's scan runs on device while segment i's cuts download
+(async) and its digest tiles are assembled on host.
 
 The reference executes the same logical pipeline one byte / one chunk at a
 time on the CPU (``dir_packer.rs:246-311``).
@@ -17,25 +28,25 @@ time on the CPU (``dir_packer.rs:246-311``).
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Tuple
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import defaults
-from .blake3_tpu import digest_padded
+from .blake3_tpu import blake3_many_tpu, digest_padded
 from .cdc_cpu import chunk_stream as chunk_stream_cpu
 from .cdc_cpu import cuts_to_chunks, select_cuts
-from .blake3_tpu import blake3_many_tpu
 from .cdc_tpu import (
     _HALO,
     TpuCdcScanner,
     _decode_words,
+    _round_up,
     _scan_segment,
     _segment_bucket,
-    scan_words_batch,
-    unpack_scan_words,
+    scan_select_batch,
 )
 from .gear import CDCParams
 
@@ -51,21 +62,61 @@ def _pad_to(arr: np.ndarray, n: int) -> np.ndarray:
     return out
 
 
+def _async_to_host(arr) -> None:
+    """Start a device->host copy in the background when the runtime
+    supports it; ``np.asarray`` later completes (or performs) it."""
+    try:
+        arr.copy_to_host_async()
+    except AttributeError:
+        pass
+
+
+def _row_tiles(count: int, cap: int = 128) -> List[int]:
+    """Decompose a chunk count into digest tile heights from
+    {128, 32, 8} clamped to ``cap`` (the pipeline's ``b_bucket``).
+
+    Big tiles amortize the per-op overhead of the unrolled BLAKE3 program
+    (small-lane dispatches are latency-bound); the closed set keeps the
+    compiled-program universe finite.  Padding waste is bounded: <=64 rows
+    once, <=16 rows once, <=7 rows once.
+    """
+    out: List[int] = []
+    rem = count
+    if cap >= 128:
+        while rem >= 128:
+            out.append(128)
+            rem -= 128
+        if rem >= 64:
+            out.append(128)
+            rem = 0
+    if cap >= 32:
+        while rem >= 32:
+            out.append(32)
+            rem -= 32
+        if rem >= 16:
+            out.append(32)
+            rem = 0
+    while rem > 0:
+        out.append(8)
+        rem -= 8
+    return out
+
+
 @functools.partial(jax.jit, static_argnames=("B", "L"),
                    donate_argnames=("acc",))
 def _gather_digest(flat: jnp.ndarray, meta: jnp.ndarray, start: jnp.ndarray,
                    acc: jnp.ndarray, *, B: int, L: int) -> jnp.ndarray:
-    """Fused HBM gather + batched BLAKE3 for one (B, L) chunk bucket.
+    """Fused HBM gather + batched BLAKE3 for one (B, L) chunk tile.
 
     ``meta`` is the (3, total) i32 array of [offsets; lengths; starts]
-    covering every bucket of the batch — uploaded once; each bucket call
+    covering every tile of the batch — uploaded once; each tile call
     slices its ``[start, start+B)`` window on device (``start`` is traced,
-    so varying bucket layouts never recompile — only (B, L) combinations
+    so varying tile layouts never recompile — only (B, L) combinations
     do), gathers the chunk spans out of the resident ``flat`` stream,
     digests, and writes the root chaining values into the donated ``acc``
     at the same window.  One fixed-shape ``acc`` download then returns
-    every bucket's digests — no variable-shape concatenation, no
-    per-bucket transfers.
+    every tile's digests — no variable-shape concatenation, no per-tile
+    transfers.
     """
     offs = jax.lax.dynamic_slice(meta[0], (start,), (B,))
     lens = jax.lax.dynamic_slice(meta[1], (start,), (B,))
@@ -109,6 +160,193 @@ class DevicePipeline:
         self.b_bucket = b_bucket
         self._nv_cache: dict = {}
 
+    # --- scan + select (device) -------------------------------------------
+
+    def _caps(self, padded: int) -> Tuple[int, int, int]:
+        """(s_cap, l_cap, cut_cap) for a padded row length."""
+        p = self.params
+        l_cap = max(512, _round_up(16 * max(1, padded >> p.mask_l_bits), 512))
+        cut_cap = padded // p.min_size + 1
+        return l_cap, l_cap, cut_cap
+
+    def _nv_device(self, nv: np.ndarray) -> jnp.ndarray:
+        nv = np.asarray(nv, dtype=np.int32)
+        key = nv.tobytes()
+        nv_d = self._nv_cache.get(key)
+        if nv_d is None:
+            if len(self._nv_cache) > 64:
+                self._nv_cache.clear()
+            nv_d = self._nv_cache[key] = jnp.asarray(nv)
+        return nv_d
+
+    def scan_select_dispatch(self, buf_d: jnp.ndarray,
+                             nv: np.ndarray) -> jnp.ndarray:
+        """Dispatch the fused scan+select; returns the device packed-cuts
+        array and starts its async download."""
+        p = self.params
+        padded = int(buf_d.shape[1]) - _HALO
+        s_cap, l_cap, cut_cap = self._caps(padded)
+        packed_d = scan_select_batch(
+            buf_d, self._nv_device(nv),
+            min_size=p.min_size, desired_size=p.desired_size,
+            max_size=p.max_size, mask_s=p.mask_s, mask_l=p.mask_l,
+            s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap)
+        _async_to_host(packed_d)
+        return packed_d
+
+    def scan_select_collect(self, packed_d: jnp.ndarray, buf_d: jnp.ndarray,
+                            nv: np.ndarray,
+                            strict_overflow: bool = False) -> List[List[tuple]]:
+        """Packed device cuts -> per-row [(offset, length)...] chunk lists.
+
+        Overflowed rows (sparse capacity exceeded — adversarial data) are
+        re-chunked with the CPU oracle to stay bit-identical, unless
+        ``strict_overflow`` (benchmarks must never silently time the
+        oracle)."""
+        packed = np.asarray(packed_d)
+        nv = np.asarray(nv, dtype=np.int32)
+        per_row: List[List[tuple]] = []
+        for r in range(packed.shape[0]):
+            overflow, n_cuts = int(packed[r, 0]), int(packed[r, 1])
+            if overflow:
+                if strict_overflow:
+                    raise RuntimeError("candidate overflow in scan+select")
+                row_bytes = bytes(np.asarray(
+                    buf_d[r, _HALO:_HALO + int(nv[r])]))
+                per_row.append(chunk_stream_cpu(row_bytes, self.params))
+            else:
+                per_row.append(cuts_to_chunks(packed[r, 2:2 + n_cuts]))
+        return per_row
+
+    # --- gather + digest (device) -----------------------------------------
+
+    def digest_dispatch(self, buf_d: jnp.ndarray,
+                        per_row: List[List[tuple]]):
+        """Dispatch gather+digest tiles for one resident batch; returns an
+        opaque pending handle for :meth:`digest_collect`."""
+        row = int(buf_d.shape[1])
+        span_max = self.l_bucket * CHUNK_LEN
+        flat = jnp.pad(buf_d.reshape(-1), (0, span_max))
+        groups: dict = {}
+        for r, chunks in enumerate(per_row):
+            base = r * row + _HALO
+            for ci, (off, ln) in enumerate(chunks):
+                groups.setdefault(self._chunk_bucket(ln), []).append(
+                    (base + off, ln, r, ci))
+        if not groups:
+            return None
+        tiles: List[tuple] = []  # (start, Bb, Lb, [(r, ci)...])
+        offs_parts: List[np.ndarray] = []
+        lens_parts: List[np.ndarray] = []
+        start = 0
+        for Lb, items in sorted(groups.items()):
+            pos = 0
+            for Bb in _row_tiles(len(items), self.b_bucket):
+                part = items[pos:pos + Bb]
+                pos += Bb
+                o = np.zeros(Bb, dtype=np.int32)
+                ln_arr = np.zeros(Bb, dtype=np.int32)
+                for q, (off, ln, _r, _ci) in enumerate(part):
+                    o[q] = off
+                    ln_arr[q] = ln
+                offs_parts.append(o)
+                lens_parts.append(ln_arr)
+                tiles.append((start, Bb, Lb,
+                              [(r, ci) for _o, _l, r, ci in part]))
+                start += Bb
+        # one meta upload; per-tile starts are sliced from it on device so
+        # tile layout never recompiles _gather_digest, and the total is
+        # padded to a power of two so neither does meta's shape
+        starts = np.array([st for st, _b, _l, _t in tiles], dtype=np.int32)
+        total = 256
+        while total < max(start, len(starts)):
+            total *= 2
+        meta = jnp.asarray(np.stack([
+            _pad_to(np.concatenate(offs_parts), total),
+            _pad_to(np.concatenate(lens_parts), total),
+            _pad_to(starts, total)]))
+        acc = jnp.zeros((total, 8), dtype=jnp.uint32)
+        for i, (_st, Bb, Lb, _tags) in enumerate(tiles):
+            acc = _gather_digest(flat, meta, meta[2, i], acc, B=Bb, L=Lb)
+        _async_to_host(acc)
+        return acc, tiles
+
+    def digest_collect(self, pending,
+                       per_row: List[List[tuple]]
+                       ) -> List[Tuple[List[tuple], np.ndarray]]:
+        """Pending digest handle -> per-row (chunks, digests)."""
+        if pending is None:
+            return [(chunks, np.zeros((0, 32), dtype=np.uint8))
+                    for chunks in per_row]
+        acc, tiles = pending
+        allcv = np.asarray(acc)
+        dig8 = np.ascontiguousarray(allcv.astype("<u4")).view(
+            np.uint8).reshape(-1, 32)
+        digests_per_row = [np.zeros((len(c), 32), dtype=np.uint8)
+                           for c in per_row]
+        for st, _Bb, _Lb, tags in tiles:
+            for q, (r, ci) in enumerate(tags):
+                digests_per_row[r][ci] = dig8[st + q]
+        return [(per_row[r], digests_per_row[r])
+                for r in range(len(per_row))]
+
+    # --- composed drivers --------------------------------------------------
+
+    def manifest_resident_batch(self, buf_d: jnp.ndarray, nv: np.ndarray,
+                                strict_overflow: bool = False,
+                                ) -> List[Tuple[List[tuple], np.ndarray]]:
+        """One resident ``(B, _HALO + P)`` batch -> per-row
+        (chunks, digests).
+
+        ``buf_d`` rows are ``_HALO`` zero bytes then the stream (zero-padded
+        to P); ``nv`` holds true lengths.  This is the exact code path the
+        engine's backup runs per batch — ``bench.py`` times it (pipelined
+        across segments via :meth:`manifest_segments`).
+        """
+        packed_d = self.scan_select_dispatch(buf_d, nv)
+        per_row = self.scan_select_collect(packed_d, buf_d, nv,
+                                           strict_overflow)
+        pending = self.digest_dispatch(buf_d, per_row)
+        return self.digest_collect(pending, per_row)
+
+    def manifest_segments(self, segments,
+                          strict_overflow: bool = False):
+        """Software-pipelined driver over resident batches (generator).
+
+        ``segments`` is any iterable of ``(buf_d, nv)``; batches are pulled
+        (and thus staged to HBM) lazily, at most ~3 in flight, so callers
+        can stream arbitrarily many batches without holding them all
+        resident.  While batch i's packed cuts cross the (high-latency)
+        host link, batch i+1's scan runs on device; digests download
+        asynchronously one stage later.  Steady-state wall clock approaches
+        pure device compute instead of compute + 2 round trips per batch.
+        Yields each batch's per-row results in order.
+        """
+        it = iter(segments)
+        scans: deque = deque()
+        digs: deque = deque()
+
+        def pump_scan():
+            for buf_d, nv in it:
+                scans.append((buf_d, nv,
+                              self.scan_select_dispatch(buf_d, nv)))
+                return
+
+        pump_scan()
+        pump_scan()
+        while scans or digs:
+            if scans:
+                buf_d, nv, packed_d = scans.popleft()
+                per_row = self.scan_select_collect(
+                    packed_d, buf_d, nv, strict_overflow)
+                digs.append((per_row,
+                             self.digest_dispatch(buf_d, per_row)))
+                del buf_d  # batch bytes may be freed once tiles dispatched
+                pump_scan()
+            while digs and (len(digs) >= 2 or not scans):
+                per_row, pending = digs.popleft()
+                yield self.digest_collect(pending, per_row)
+
     def process_segment(self, stream: jnp.ndarray, n_valid: int,
                         prev_tail: bytes = b"") -> Tuple[List[tuple], np.ndarray]:
         """One resident segment -> (chunks [(offset, length)...], digests).
@@ -118,29 +356,19 @@ class DevicePipeline:
         ``prev_tail`` is ignored for cut semantics here: segments fed to the
         bench are independent streams.
         """
-        p = self.params
         ext = jnp.concatenate(
-            [jnp.zeros(_HALO, dtype=jnp.uint8), stream])
-        k_cap = self.scanner._k_cap(int(stream.shape[0]))
-        widx, wl, ws, nz = _scan_segment(
-            ext, jnp.int32(n_valid), jnp.uint32(p.mask_s),
-            jnp.uint32(p.mask_l), k_cap=k_cap)
-        if int(nz) > k_cap:
-            raise RuntimeError("candidate overflow in bench pipeline")
-        pos_l, is_s = _decode_words(widx, wl, ws, k_cap, 0)
-        chunks = cuts_to_chunks(
-            select_cuts(pos_l[is_s], pos_l, n_valid, p))
-        digests = self.digest_chunks(stream, chunks)
+            [jnp.zeros(_HALO, dtype=jnp.uint8), stream]).reshape(1, -1)
+        nv = np.full(1, n_valid, dtype=np.int32)
+        (chunks, digests), = self.manifest_resident_batch(ext, nv)
         return chunks, digests
 
     def manifest_batch(self, streams) -> List[Tuple[List[tuple], np.ndarray]]:
         """Chunk + fingerprint a batch of independent streams, resident.
 
         Each stream's bytes are staged into HBM exactly once: streams are
-        bucketed by padded length, scanned with one vmapped dispatch per
-        bucket, cut selection runs on the host over the sparse candidate
-        words (tiny transfer), and chunk buffers are gathered HBM->HBM out
-        of the same resident batch before the batched BLAKE3.  Returns a
+        bucketed by padded length, scanned+selected with one fused dispatch
+        per bucket, and chunk buffers are gathered HBM->HBM out of the same
+        resident batch before the batched BLAKE3.  Returns a
         ``(chunks, digests)`` pair per stream, bit-identical to the CPU
         oracle pipeline.
         """
@@ -169,136 +397,39 @@ class DevicePipeline:
             for i, d in zip(tiny, digs):
                 out[i] = ([(0, len(streams[i]))],
                           np.frombuffer(d, dtype=np.uint8).reshape(1, 32))
-        for padded, idxs in sorted(groups.items()):
-            row = _HALO + padded
-            # bound one scan dispatch (the hash pass peaks at ~9 bytes of
-            # HBM per stream byte) and pad the row count to a power of two
-            # so arbitrary per-directory batch sizes reuse a handful of
-            # compiled shapes
-            max_rows = max(1, _SCAN_DISPATCH_BYTES // row)
-            # pow2 row padding, clamped by the dispatch budget (largest
-            # pow2 <= max_rows): a lone 128 MiB stream must not balloon
-            # to 8 identical rows, and a full part must not double past
-            # the budget — so slice by the pow2 cap itself
-            b_cap = 1 << (max_rows.bit_length() - 1)
-            for s0 in range(0, len(idxs), b_cap):
-                part = idxs[s0:s0 + b_cap]
-                B = min(8, b_cap)
-                while B < len(part):
-                    B *= 2
-                buf = np.zeros((B, row), dtype=np.uint8)
-                nv = np.zeros(B, dtype=np.int32)
-                for r, i in enumerate(part):
-                    d = np.frombuffer(bytes(streams[i]), dtype=np.uint8)
-                    buf[r, _HALO:_HALO + len(d)] = d
-                    nv[r] = len(d)
-                results = self.manifest_resident_batch(jnp.asarray(buf), nv)
-                for r, i in enumerate(part):
-                    out[i] = results[r]
+        # stage resident batches lazily through the pipelined driver: at
+        # most ~3 batches (each bounded by the dispatch budget) live in HBM
+        # at once, however large the whole call is
+        batch_rows: deque = deque()
+
+        def batch_gen():
+            for padded, idxs in sorted(groups.items()):
+                row = _HALO + padded
+                max_rows = max(1, _SCAN_DISPATCH_BYTES // row)
+                # pow2 row padding, clamped by the dispatch budget (largest
+                # pow2 <= max_rows): a lone 128 MiB stream must not balloon
+                # to 8 identical rows, and a full part must not double past
+                # the budget — so slice by the pow2 cap itself
+                b_cap = 1 << (max_rows.bit_length() - 1)
+                for s0 in range(0, len(idxs), b_cap):
+                    part = idxs[s0:s0 + b_cap]
+                    B = min(8, b_cap)
+                    while B < len(part):
+                        B *= 2
+                    buf = np.zeros((B, row), dtype=np.uint8)
+                    nv = np.zeros(B, dtype=np.int32)
+                    for r, i in enumerate(part):
+                        d = np.frombuffer(bytes(streams[i]), dtype=np.uint8)
+                        buf[r, _HALO:_HALO + len(d)] = d
+                        nv[r] = len(d)
+                    batch_rows.append(part)
+                    yield jnp.asarray(buf), nv
+
+        for results in self.manifest_segments(batch_gen()):
+            part = batch_rows.popleft()
+            for r, i in enumerate(part):
+                out[i] = results[r]
         return out
-
-    def manifest_resident_batch(self, buf_d: jnp.ndarray, nv: np.ndarray,
-                                strict_overflow: bool = False,
-                                ) -> List[Tuple[List[tuple], np.ndarray]]:
-        """The device core of :meth:`manifest_batch`: one resident
-        ``(B, _HALO + P)`` batch -> per-row (chunks, digests).
-
-        ``buf_d`` rows are ``_HALO`` zero bytes then the stream (zero-padded
-        to P); ``nv`` holds true lengths.  This is the exact code path the
-        engine's backup runs per batch — ``bench.py`` times it directly.
-        ``strict_overflow`` raises on sparse-capacity overflow instead of
-        falling back to the CPU oracle (benchmarks must not silently time
-        the oracle).
-        """
-        p = self.params
-        B, row = int(buf_d.shape[0]), int(buf_d.shape[1])
-        padded = row - _HALO
-        k_cap = self.scanner._k_cap(padded)
-        # round trip 1: one packed download of every row's sparse candidates
-        # (repeated nv vectors reuse their device copy — upload once)
-        nv = np.asarray(nv, dtype=np.int32)
-        nv_key = nv.tobytes()
-        nv_d = self._nv_cache.get(nv_key)
-        if nv_d is None:
-            if len(self._nv_cache) > 64:
-                self._nv_cache.clear()
-            nv_d = self._nv_cache[nv_key] = jnp.asarray(nv)
-        packed = np.asarray(scan_words_batch(
-            buf_d, nv_d, mask_s=p.mask_s, mask_l=p.mask_l, k_cap=k_cap))
-        per_row: List[List[tuple]] = []
-        for r in range(B):
-            n = int(nv[r])
-            nz, widx, wl, ws = unpack_scan_words(packed[r], k_cap)
-            if nz > k_cap:
-                if strict_overflow:
-                    raise RuntimeError(
-                        f"candidate overflow: {nz} words > {k_cap}")
-                # sparse capacity overflow (adversarial data): oracle
-                # rescan of this one stream keeps output bit-identical
-                row_bytes = bytes(np.asarray(buf_d[r, _HALO:_HALO + n]))
-                per_row.append(chunk_stream_cpu(row_bytes, p))
-            else:
-                pos_l, is_s = _decode_words(widx, wl, ws, k_cap, 0)
-                per_row.append(cuts_to_chunks(
-                    select_cuts(pos_l[is_s], pos_l, n, p)))
-        # bucket every chunk of the batch for the fused gather+digest;
-        # (offsets; lengths) ride to the device as ONE meta upload and all
-        # bucket digests come back as ONE concatenated download
-        span_max = self.l_bucket * CHUNK_LEN
-        flat = jnp.pad(buf_d.reshape(-1), (0, span_max))
-        groups: dict = {}
-        for r, chunks in enumerate(per_row):
-            base = r * row + _HALO
-            for ci, (off, ln) in enumerate(chunks):
-                groups.setdefault(self._chunk_bucket(ln), []).append(
-                    (base + off, ln, r, ci))
-        if not groups:
-            return [(per_row[r], np.zeros((0, 32), dtype=np.uint8))
-                    for r in range(B)]
-        buckets: List[tuple] = []  # (start, Bb, Lb, [(r, ci)...])
-        offs_parts: List[np.ndarray] = []
-        lens_parts: List[np.ndarray] = []
-        start = 0
-        for Lb, items in sorted(groups.items()):
-            for s0 in range(0, len(items), self.b_bucket):
-                part = items[s0:s0 + self.b_bucket]
-                Bb = 8
-                while Bb < len(part):
-                    Bb *= 2
-                o = np.zeros(Bb, dtype=np.int32)
-                ln_arr = np.zeros(Bb, dtype=np.int32)
-                for q, (off, ln, _r, _ci) in enumerate(part):
-                    o[q] = off
-                    ln_arr[q] = ln
-                offs_parts.append(o)
-                lens_parts.append(ln_arr)
-                buckets.append((start, Bb, Lb,
-                                [(r, ci) for _o, _l, r, ci in part]))
-                start += Bb
-        # round trip 2: one meta upload; per-bucket starts are sliced from
-        # it on device so bucket layout never recompiles _gather_digest, and
-        # the total is padded to a power of two so neither does meta's shape
-        starts = np.array([st for st, _b, _l, _t in buckets], dtype=np.int32)
-        total = 256
-        while total < max(start, len(starts)):
-            total *= 2
-        meta = jnp.asarray(np.stack([
-            _pad_to(np.concatenate(offs_parts), total),
-            _pad_to(np.concatenate(lens_parts), total),
-            _pad_to(starts, total)]))
-        acc = jnp.zeros((total, 8), dtype=jnp.uint32)
-        for i, (_st, Bb, Lb, _tags) in enumerate(buckets):
-            acc = _gather_digest(flat, meta, meta[2, i], acc, B=Bb, L=Lb)
-        # round trip 3: one fixed-shape digest download
-        allcv = np.asarray(acc)
-        dig8 = np.ascontiguousarray(allcv.astype("<u4")).view(
-            np.uint8).reshape(-1, 32)
-        digests_per_row = [np.zeros((len(c), 32), dtype=np.uint8)
-                           for c in per_row]
-        for st, _Bb, _Lb, tags in buckets:
-            for q, (r, ci) in enumerate(tags):
-                digests_per_row[r][ci] = dig8[st + q]
-        return [(per_row[r], digests_per_row[r]) for r in range(B)]
 
     def _chunk_bucket(self, n_bytes: int) -> int:
         """Smallest leaf bucket (power of two, >=16 chunks) holding a chunk;
@@ -312,7 +443,7 @@ class DevicePipeline:
     def digest_chunks(self, stream: jnp.ndarray, chunks: List[tuple]) -> np.ndarray:
         """Gather + digest chunk spans of a resident stream; (N, 32) u8.
 
-        Chunks group into (B, L) size buckets so device work scales with
+        Chunks group into (B, L) size tiles so device work scales with
         actual bytes, not worst-case chunk size.
         """
         if not chunks:
@@ -325,12 +456,10 @@ class DevicePipeline:
         for i, (off, ln) in enumerate(chunks):
             groups.setdefault(self._chunk_bucket(ln), []).append(i)
         for L, idxs in sorted(groups.items()):
-            for s in range(0, len(idxs), self.b_bucket):
-                part = idxs[s:s + self.b_bucket]
-                bb = 8
-                while bb < len(part):
-                    bb *= 2
-                bb = min(bb, self.b_bucket)
+            pos = 0
+            for bb in _row_tiles(len(idxs), self.b_bucket):
+                part = idxs[pos:pos + bb]
+                pos += bb
                 offs = np.zeros(bb, dtype=np.int32)
                 lens = np.zeros(bb, dtype=np.int32)
                 for j, i in enumerate(part):

@@ -1,13 +1,12 @@
-"""Stage-by-stage profiler for the device-resident dedup pipeline.
+"""Stage-by-stage profiler for the redesigned device-resident pipeline.
 
-Times, with hard device syncs between stages, on a BENCH-shaped segment:
-  0. trivial-dispatch latency (the relay-tunnel floor)
-  1. scan_words_batch dispatch + download
-  2. host cut selection over the sparse words
-  3. flat pad + per-bucket _gather_digest dispatches
-  4. final digest download
-Prints a per-stage table so the optimization attacks measured cost, not
-guessed cost.
+Times, with hard device syncs between stages, on BENCH-shaped segments:
+  1. scan_select_batch (fused hash + candidate compaction + cut while_loop)
+  2. packed-cuts download + host chunk assembly
+  3. digest_dispatch (flat pad + meta upload + gather/digest tiles)
+  4. digest download
+plus sub-kernels in isolation (hash ladder alone, nonzero alone) so the
+optimization attacks measured cost, not guessed cost.
 """
 
 from __future__ import annotations
@@ -22,21 +21,31 @@ from backuwup_tpu.utils.jaxcache import enable_compilation_cache
 
 enable_compilation_cache()
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from backuwup_tpu.ops.cdc_cpu import cuts_to_chunks, select_cuts
-from backuwup_tpu.ops.cdc_tpu import _HALO, scan_words_batch, unpack_scan_words
+from backuwup_tpu.ops.cdc_tpu import _HALO, _hash_ext_fast, scan_select_batch
 from backuwup_tpu.ops.gear import CDCParams
-from backuwup_tpu.ops.pipeline import CHUNK_LEN, DevicePipeline, _gather_digest, _pad_to
+from backuwup_tpu.ops.pipeline import DevicePipeline
 
 SEG_MIB = int(os.environ.get("PROF_SEGMENT_MIB", "128"))
 REPS = int(os.environ.get("PROF_REPS", "3"))
 
 
-def sync():
+def timed(label, fn, *args, reps=3, **kw):
+    fn(*args, **kw)  # warm/compile
     jax.block_until_ready(jnp.zeros(1))
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args, **kw)
+        jax.block_until_ready(out)
+    dt = (time.time() - t0) / reps
+    print(f"  {label:42s} {dt*1e3:8.1f} ms  ({SEG_MIB/dt:7.1f} MiB/s)",
+          flush=True)
+    return out
 
 
 def main():
@@ -44,7 +53,7 @@ def main():
     pipe = DevicePipeline(params)
     seg_bytes = SEG_MIB << 20
     row = _HALO + seg_bytes
-    print(f"devices: {jax.devices()}", flush=True)
+    print(f"devices: {jax.devices()}  segment={SEG_MIB} MiB", flush=True)
 
     @jax.jit
     def synth(key):
@@ -53,130 +62,80 @@ def main():
 
     key = jax.random.PRNGKey(7)
     nv = np.full(1, seg_bytes, dtype=np.int32)
-    nv_d = jnp.asarray(nv)
-
-    # measure trivial dispatch latency
-    tiny = jax.jit(lambda x: x + 1)
-    tiny(jnp.zeros(8)).block_until_ready()
-    t0 = time.time()
-    for _ in range(10):
-        tiny(jnp.zeros(8)).block_until_ready()
-    disp = (time.time() - t0) / 10
-    print(f"trivial dispatch+sync: {disp*1e3:.1f} ms", flush=True)
-
-    # tiny download latency
-    x = jnp.zeros(8)
-    jax.block_until_ready(x)
-    t0 = time.time()
-    for _ in range(10):
-        np.asarray(tiny(x))
-    dl = (time.time() - t0) / 10
-    print(f"tiny roundtrip (dispatch+download): {dl*1e3:.1f} ms", flush=True)
-
-    # warm everything once via the production path
-    key, sub = jax.random.split(key)
-    buf = synth(sub)
+    buf = synth(key)
     jax.block_until_ready(buf)
-    pipe.manifest_resident_batch(buf, nv, strict_overflow=True)
 
-    k_cap = pipe.scanner._k_cap(seg_bytes)
-    print(f"k_cap={k_cap}", flush=True)
+    # --- sub-kernels in isolation -----------------------------------------
+    hash_j = jax.jit(lambda e: _hash_ext_fast(e[0]))
+    timed("hash ladder only", hash_j, buf)
 
+    p = params
+
+    @jax.jit
+    def hash_cand_nonzero(ext_b, n):
+        h = _hash_ext_fast(ext_b[0])
+        valid = jnp.arange(h.shape[0], dtype=jnp.int32) < n
+        cand_l = ((h & jnp.uint32(p.mask_l)) == 0) & valid
+        (pos_l,) = jnp.nonzero(cand_l, size=8192, fill_value=h.shape[0])
+        return pos_l
+
+    timed("hash + candidates + nonzero", hash_cand_nonzero, buf,
+          jnp.int32(seg_bytes))
+
+    s_cap, l_cap, cut_cap = pipe._caps(seg_bytes)
+    print(f"  caps: s={s_cap} l={l_cap} cut={cut_cap}", flush=True)
+    scan_fn = functools.partial(
+        scan_select_batch, min_size=p.min_size, desired_size=p.desired_size,
+        max_size=p.max_size, mask_s=p.mask_s, mask_l=p.mask_l,
+        s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap)
+    nv_d = jnp.asarray(nv)
+    packed_d = timed("scan_select_batch (fused)", scan_fn, buf, nv_d)
+
+    # --- full pipeline stages ---------------------------------------------
     for rep in range(REPS):
         key, sub = jax.random.split(key)
-        t0 = time.time()
         buf = synth(sub)
         jax.block_until_ready(buf)
-        t_synth = time.time() - t0
 
-        # stage 1: scan dispatch (device only)
         t0 = time.time()
-        packed_d = scan_words_batch(buf, nv_d, mask_s=params.mask_s,
-                                    mask_l=params.mask_l, k_cap=k_cap)
+        packed_d = pipe.scan_select_dispatch(buf, nv)
         jax.block_until_ready(packed_d)
         t_scan = time.time() - t0
 
-        # stage 1b: download of packed words
         t0 = time.time()
-        packed = np.asarray(packed_d)
-        t_dl1 = time.time() - t0
-
-        # stage 2: host cut selection
-        t0 = time.time()
-        from backuwup_tpu.ops.cdc_tpu import _decode_words
-        nz, widx, wl, ws = unpack_scan_words(packed[0], k_cap)
-        assert nz <= k_cap
-        pos_l, is_s = _decode_words(widx, wl, ws, k_cap, 0)
-        chunks = cuts_to_chunks(select_cuts(pos_l[is_s], pos_l, seg_bytes, params))
-        t_cut = time.time() - t0
-
-        # stage 3: flat pad
-        t0 = time.time()
-        span_max = pipe.l_bucket * CHUNK_LEN
-        flat = jnp.pad(buf.reshape(-1), (0, span_max))
-        jax.block_until_ready(flat)
-        t_pad = time.time() - t0
-
-        # stage 3b: bucket + gather_digest dispatches
-        t0 = time.time()
-        groups = {}
-        for ci, (off, ln) in enumerate(chunks):
-            groups.setdefault(pipe._chunk_bucket(ln), []).append((_HALO + off, ln, 0, ci))
-        buckets = []
-        offs_parts, lens_parts = [], []
-        start = 0
-        for Lb, items in sorted(groups.items()):
-            for s0 in range(0, len(items), pipe.b_bucket):
-                part = items[s0:s0 + pipe.b_bucket]
-                Bb = 8
-                while Bb < len(part):
-                    Bb *= 2
-                o = np.zeros(Bb, dtype=np.int32)
-                ln_arr = np.zeros(Bb, dtype=np.int32)
-                for q, (off, ln, _r, _ci) in enumerate(part):
-                    o[q] = off
-                    ln_arr[q] = ln
-                offs_parts.append(o)
-                lens_parts.append(ln_arr)
-                buckets.append((start, Bb, Lb, None))
-                start += Bb
-        starts = np.array([st for st, _b, _l, _t in buckets], dtype=np.int32)
-        total = 256
-        while total < max(start, len(starts)):
-            total *= 2
-        meta = jnp.asarray(np.stack([
-            _pad_to(np.concatenate(offs_parts), total),
-            _pad_to(np.concatenate(lens_parts), total),
-            _pad_to(starts, total)]))
-        acc = jnp.zeros((total, 8), dtype=jnp.uint32)
-        jax.block_until_ready(meta)
-        t_meta = time.time() - t0
+        per_row = pipe.scan_select_collect(packed_d, buf, nv, True)
+        t_collect = time.time() - t0
 
         t0 = time.time()
-        for i, (_st, Bb, Lb, _tags) in enumerate(buckets):
-            acc = _gather_digest(flat, meta, meta[2, i], acc, B=Bb, L=Lb)
-        jax.block_until_ready(acc)
+        pending = pipe.digest_dispatch(buf, per_row)
+        jax.block_until_ready(pending[0])
         t_dig = time.time() - t0
 
         t0 = time.time()
-        allcv = np.asarray(acc)
-        t_dl2 = time.time() - t0
+        results = pipe.digest_collect(pending, per_row)
+        t_dl = time.time() - t0
 
-        tot = t_scan + t_dl1 + t_cut + t_pad + t_meta + t_dig + t_dl2
-        print(f"rep{rep}: synth={t_synth*1e3:7.1f}  scan={t_scan*1e3:7.1f}  "
-              f"dl1={t_dl1*1e3:6.1f}  cut={t_cut*1e3:6.1f}  pad={t_pad*1e3:6.1f}  "
-              f"meta={t_meta*1e3:6.1f}  digest={t_dig*1e3:7.1f} ({len(buckets)} buckets, "
-              f"{len(chunks)} chunks)  dl2={t_dl2*1e3:6.1f}  "
-              f"TOTAL={tot*1e3:7.1f} ms -> {SEG_MIB/tot:6.1f} MiB/s", flush=True)
+        tot = t_scan + t_collect + t_dig + t_dl
+        n_tiles = len(pending[1])
+        print(f"rep{rep}: scan+select={t_scan*1e3:7.1f}  "
+              f"collect={t_collect*1e3:6.1f}  "
+              f"digest={t_dig*1e3:7.1f} ({n_tiles} tiles, "
+              f"{len(per_row[0])} chunks)  dl={t_dl*1e3:6.1f}  "
+              f"TOTAL={tot*1e3:7.1f} ms -> {SEG_MIB/tot:6.1f} MiB/s",
+              flush=True)
 
-    print("\nper-(B,L) single-dispatch timings:", flush=True)
-    for (st, Bb, Lb, _t) in buckets[:6]:
-        t0 = time.time()
-        acc = _gather_digest(flat, meta, meta[2, 0], acc, B=Bb, L=Lb)
-        jax.block_until_ready(acc)
-        t1 = time.time() - t0
-        print(f"  B={Bb:4d} L={Lb:5d} ({Bb*Lb/1024:7.1f} MiB padded): {t1*1e3:7.1f} ms "
-              f"-> {Bb*Lb/1024/t1:7.1f} MiB/s", flush=True)
+    # --- pipelined driver end to end --------------------------------------
+    segs = []
+    for _ in range(4):
+        key, sub = jax.random.split(key)
+        segs.append((synth(sub), nv))
+    jax.block_until_ready([b for b, _ in segs])
+    list(pipe.manifest_segments(segs, strict_overflow=True))  # warm
+    t0 = time.time()
+    list(pipe.manifest_segments(segs, strict_overflow=True))
+    dt = time.time() - t0
+    print(f"pipelined 4x{SEG_MIB} MiB: {dt:.2f}s -> {4*SEG_MIB/dt:.1f} MiB/s",
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,183 @@
+"""Isolate the scan+select bottleneck with FRESH inputs per rep (the relay
+caches identical dispatches, so same-input timings lie)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from backuwup_tpu.utils.jaxcache import enable_compilation_cache
+
+enable_compilation_cache()
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from backuwup_tpu.ops.cdc_tpu import _HALO, _hash_ext_fast, scan_select_batch
+from backuwup_tpu.ops.gear import CDCParams
+from backuwup_tpu.ops.pipeline import DevicePipeline
+
+SEG_MIB = int(os.environ.get("PROF_SEGMENT_MIB", "128"))
+seg_bytes = SEG_MIB << 20
+row = _HALO + seg_bytes
+params = CDCParams()
+pipe = DevicePipeline(params)
+s_cap, l_cap, cut_cap = pipe._caps(seg_bytes)
+P = seg_bytes
+
+
+@jax.jit
+def synth(key):
+    seg = jax.random.randint(key, (seg_bytes,), 0, 256, dtype=jnp.uint8)
+    return jnp.concatenate([jnp.zeros(_HALO, dtype=jnp.uint8), seg]).reshape(1, row)
+
+
+def bench(label, fn, keys):
+    out = fn(synth(keys[0]))  # warm/compile
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for k in keys[1:]:
+        buf = synth(k)
+        jax.block_until_ready(buf)
+        t1 = time.time()
+        out = fn(buf)
+        jax.block_until_ready(out)
+    dt = time.time() - t1  # last rep only (excludes synth)
+    print(f"{label:46s} {dt*1e3:9.1f} ms ({SEG_MIB/dt:8.1f} MiB/s)",
+          flush=True)
+
+
+def keysplit(key, n):
+    out = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        out.append(sub)
+    return key, out
+
+
+p = params
+ms, ml = jnp.uint32(p.mask_s), jnp.uint32(p.mask_l)
+
+
+@jax.jit
+def hash_only(buf):
+    return _hash_ext_fast(buf[0])
+
+
+@jax.jit
+def hash_cand(buf):
+    h = _hash_ext_fast(buf[0])
+    valid = jnp.arange(P, dtype=jnp.int32) < P
+    cand_l = ((h & ml) == 0) & valid
+    cand_s = cand_l & ((h & ms) == 0)
+    return jnp.sum(cand_l.astype(jnp.int32)), jnp.sum(cand_s.astype(jnp.int32))
+
+
+@jax.jit
+def hash_cand_nonzero(buf):
+    h = _hash_ext_fast(buf[0])
+    valid = jnp.arange(P, dtype=jnp.int32) < P
+    cand_l = ((h & ml) == 0) & valid
+    cand_s = cand_l & ((h & ms) == 0)
+    (pos_l,) = jnp.nonzero(cand_l, size=l_cap, fill_value=P)
+    (pos_s,) = jnp.nonzero(cand_s, size=s_cap, fill_value=P)
+    return pos_l, pos_s
+
+
+def _select_loop(pos_s, pos_l, n, lower_bound):
+    def cond(st):
+        s, k, _ = st
+        return s < n
+
+    def body(st):
+        s, k, cuts = st
+        lo = s + jnp.int32(p.min_size - 1)
+        hi = jnp.minimum(s + jnp.int32(p.desired_size - 2), n - 2)
+        i = lower_bound(pos_s, lo)
+        e1 = pos_s[jnp.minimum(i, s_cap - 1)]
+        ok1 = (i < s_cap) & (e1 <= hi)
+        lo2 = s + jnp.int32(p.desired_size - 1)
+        hi2 = jnp.minimum(s + jnp.int32(p.max_size - 2), n - 2)
+        j = lower_bound(pos_l, lo2)
+        e2 = pos_l[jnp.minimum(j, l_cap - 1)]
+        ok2 = (j < l_cap) & (e2 <= hi2)
+        e = jnp.where(ok1, e1, jnp.where(
+            ok2, e2, jnp.minimum(s + jnp.int32(p.max_size - 1), n - 1)))
+        e = jnp.where(n - s <= jnp.int32(p.min_size), n - 1, e)
+        cuts = cuts.at[k].set(e)
+        return e + 1, k + 1, cuts
+
+    return jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), jnp.int32(0), jnp.full(cut_cap, -1, jnp.int32)))
+
+
+@jax.jit
+def full_searchsorted(buf):
+    pos_l, pos_s = _nonzero(buf)
+    return _select_loop(pos_s, pos_l, jnp.int32(P),
+                        lambda a, v: jnp.searchsorted(a, v, side="left"))
+
+
+def _nonzero(buf):
+    h = _hash_ext_fast(buf[0])
+    valid = jnp.arange(P, dtype=jnp.int32) < P
+    cand_l = ((h & ml) == 0) & valid
+    cand_s = cand_l & ((h & ms) == 0)
+    (pos_l,) = jnp.nonzero(cand_l, size=l_cap, fill_value=P)
+    (pos_s,) = jnp.nonzero(cand_s, size=s_cap, fill_value=P)
+    return pos_l.astype(jnp.int32), pos_s.astype(jnp.int32)
+
+
+@jax.jit
+def full_sumlb(buf):
+    pos_l, pos_s = _nonzero(buf)
+    return _select_loop(pos_s, pos_l, jnp.int32(P),
+                        lambda a, v: jnp.sum((a < v).astype(jnp.int32)))
+
+
+scan_fn = functools.partial(
+    scan_select_batch, min_size=p.min_size, desired_size=p.desired_size,
+    max_size=p.max_size, mask_s=p.mask_s, mask_l=p.mask_l,
+    s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap)
+nv_d = jnp.asarray(np.full(1, seg_bytes, dtype=np.int32))
+
+
+def main():
+    print(f"devices: {jax.devices()}  segment={SEG_MIB} MiB  "
+          f"caps s={s_cap} l={l_cap} cut={cut_cap}", flush=True)
+    key = jax.random.PRNGKey(3)
+    for label, fn in [
+        ("hash ladder only", hash_only),
+        ("hash + cand counts", hash_cand),
+        ("hash + cand + 2x nonzero", hash_cand_nonzero),
+        ("full select (searchsorted while_loop)", full_searchsorted),
+        ("full select (sum lower_bound while_loop)", full_sumlb),
+        ("production scan_select_batch", lambda b: scan_fn(b, nv_d)),
+    ]:
+        key, keys = keysplit(key, 3)
+        bench(label, fn, keys)
+
+    # digest steady state with fresh data
+    key, keys = keysplit(key, 3)
+    nv = np.full(1, seg_bytes, dtype=np.int32)
+    for k in keys:
+        buf = synth(k)
+        jax.block_until_ready(buf)
+        packed = pipe.scan_select_dispatch(buf, nv)
+        per_row = pipe.scan_select_collect(packed, buf, nv, True)
+        t0 = time.time()
+        pending = pipe.digest_dispatch(buf, per_row)
+        jax.block_until_ready(pending[0])
+        print(f"digest ({len(per_row[0])} chunks, {len(pending[1])} tiles): "
+              f"{(time.time()-t0)*1e3:8.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
