@@ -29,11 +29,27 @@ class ClientApp:
                  server_addr: Optional[str] = None,
                  backend: Optional[ChunkerBackend] = None,
                  messenger: Optional[Messenger] = None,
-                 dedup_mesh=None):
+                 dedup_mesh=None,
+                 root_secret: Optional[bytes] = None):
+        """``root_secret`` injects a recovered identity (the
+        restore-from-phrase flow, ``identity.rs:46-69``): the secret is
+        persisted and all keys re-derive deterministically, so a disaster
+        recovery proceeds as this identity.  Raises if the store already
+        holds a *different* identity."""
         self.store = Store(config_dir, data_base=data_dir)
         self.messenger = messenger or Messenger()
         secret = self.store.get_root_secret()
-        if secret is None:
+        if root_secret is not None:
+            if secret is not None and secret != root_secret:
+                self.store.close()
+                raise ValueError(
+                    "store already holds a different identity; refusing to "
+                    "overwrite it with the recovered secret")
+            self.keys = KeyManager.from_secret(root_secret)
+            if secret is None:
+                self.store.set_root_secret(root_secret)
+            self.fresh_identity = secret is None
+        elif secret is None:
             self.keys = KeyManager.generate()
             self.store.set_root_secret(self.keys.root_secret)
             self.store.set_obfuscation_key(os.urandom(4))
@@ -51,6 +67,12 @@ class ClientApp:
         self.engine = Engine(self.keys, self.store, self.server, self.node,
                              backend=backend, messenger=self.messenger,
                              dedup_mesh=dedup_mesh)
+
+    @classmethod
+    def from_phrase(cls, phrase: str, **kwargs) -> "ClientApp":
+        """Rebuild an identity from its recovery phrase (cli.rs:26-51)."""
+        from .crypto import phrase_to_secret
+        return cls(root_secret=phrase_to_secret(phrase), **kwargs)
 
     @property
     def client_id(self) -> bytes:

@@ -96,6 +96,10 @@ class Engine:
             self.device_dedup = MeshDedupIndex(dedup_mesh, self.index)
         self.orchestrator = Orchestrator()
         self.last_pack_stats = None
+        # backup and restore are mutually exclusive and non-reentrant
+        # (restore_orchestrator.rs:45-56); a second start must fail loudly,
+        # not corrupt the pack dir with a concurrent packer
+        self._exclusive = asyncio.Lock()
 
     # --- paths -------------------------------------------------------------
 
@@ -155,6 +159,12 @@ class Engine:
     # --- backup ------------------------------------------------------------
 
     async def run_backup(self, root: Optional[Path] = None) -> bytes:
+        if self._exclusive.locked():
+            raise EngineError("a backup or restore is already running")
+        async with self._exclusive:
+            return await self._run_backup_locked(root)
+
+    async def _run_backup_locked(self, root: Optional[Path]) -> bytes:
         root = Path(root or (self.store.get_backup_path() or ""))
         if not root.is_dir():
             raise EngineError(f"backup path {root} is not a directory")
@@ -327,6 +337,12 @@ class Engine:
     # --- restore (backup/mod.rs:117-192) -----------------------------------
 
     async def run_restore(self, dest: Optional[Path] = None) -> Path:
+        if self._exclusive.locked():
+            raise EngineError("a backup or restore is already running")
+        async with self._exclusive:
+            return await self._run_restore_locked(dest)
+
+    async def _run_restore_locked(self, dest: Optional[Path]) -> Path:
         last = self.store.last_event_time(EVENT_RESTORE_REQUEST)
         if last is not None and \
                 time.time() - last < defaults.RESTORE_REQUEST_THROTTLE_S:

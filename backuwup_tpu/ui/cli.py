@@ -1,0 +1,65 @@
+"""First-run CLI: recovery-phrase UX (``client/src/ui/cli.rs``).
+
+Fresh setup prints the recovery phrase derived from the root secret
+(``cli.rs:55-77``, the BIP39-mnemonic analog); the restore path prompts for
+an existing phrase and rebuilds the identity deterministically
+(``cli.rs:26-51`` + ``identity.rs:46-69``).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+from ..crypto import phrase_to_secret, secret_to_phrase
+
+BANNER = """\
+Welcome to backuwup!
+
+Your backups are encrypted with keys derived from a single root secret.
+The RECOVERY PHRASE below is the only way to get your data back after a
+disaster — write it down and keep it somewhere safe and offline.
+"""
+
+
+def print_recovery_phrase(root_secret: bytes, out=None) -> None:
+    out = out or sys.stdout
+    print(BANNER, file=out)
+    print("    " + secret_to_phrase(root_secret), file=out)
+    print("\nAnyone with this phrase can read your backups; never share it.",
+          file=out)
+
+
+def prompt_restore_phrase(input_fn: Optional[Callable[[str], str]] = None,
+                          out=None) -> bytes:
+    """Interactive phrase entry with validation loop (cli.rs:26-51);
+    returns the decoded root secret."""
+    input_fn = input_fn or input
+    out = out or sys.stdout
+    while True:
+        phrase = input_fn("Enter your recovery phrase: ")
+        try:
+            return phrase_to_secret(phrase)
+        except ValueError as e:
+            print(f"That phrase is not valid ({e}); try again.", file=out)
+
+
+def first_run_guide(input_fn: Optional[Callable[[str], str]] = None,
+                    out=None) -> Optional[bytes]:
+    """Fresh-start vs restore choice (cli.rs:10-23).
+
+    Returns None to create a new identity, or the decoded root secret to
+    restore an existing one.
+    """
+    input_fn = input_fn or input
+    out = out or sys.stdout
+    print("No existing identity found.", file=out)
+    while True:
+        ans = input_fn(
+            "Start fresh (n) or restore from a recovery phrase (r)? [n/r] ")
+        ans = ans.strip().lower()
+        if ans in ("", "n", "new"):
+            return None
+        if ans in ("r", "restore"):
+            return prompt_restore_phrase(input_fn, out)
+        print("Please answer 'n' or 'r'.", file=out)

@@ -82,6 +82,21 @@ class Messenger:
             self._last_progress = now
             self._emit(StatusEvent("progress", asdict(p)))
 
+    def tick(self) -> None:
+        """Undebounced snapshot push (the 400 ms ticker and late-joining
+        UI clients; backup/mod.rs:109-114)."""
+        self._emit(StatusEvent("progress", asdict(self.progress_state)))
+
+    def peers(self, peers: list) -> None:
+        """Peer-ledger telemetry frame (ws_status_message.rs:128-163)."""
+        self._emit(StatusEvent("peers", {"peers": peers}))
+
+    def config(self, cfg: dict) -> None:
+        self._emit(StatusEvent("config", cfg))
+
+    def error(self, text: str) -> None:
+        self._emit(StatusEvent("error", {"text": text}))
+
     def backup_started(self) -> None:
         self.progress_state = Progress(running=True)
         self._emit(StatusEvent("backup_started"))
