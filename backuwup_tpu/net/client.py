@@ -14,6 +14,7 @@ uses for testing (requests.rs:246-258).
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 from typing import Callable, Optional
 
@@ -175,8 +176,12 @@ class ServerClient:
                 self.store.set_auth_token(None)
             except asyncio.CancelledError:
                 raise
-            except Exception:
-                pass
+            except (aiohttp.ClientError, ServerError, OSError,
+                    RuntimeError) as e:
+                # reconnect loop (net_server/mod.rs:26-55): log, back off,
+                # retry — but never swallow unrelated programming errors
+                logging.getLogger(__name__).debug(
+                    "server WS dropped: %s; reconnecting", e)
             self.ws_connected.clear()
             await asyncio.sleep(0.2)
 

@@ -318,6 +318,40 @@ def scan_select_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
     ms = jnp.uint32(mask_s)
     ml = jnp.uint32(mask_l)
 
+    # word-level sparse capacity for the two-level compaction below;
+    # nearly every candidate lands in its own 32-bit word on real data
+    w_cap = max(512, min(l_cap, P // 32 if P >= 32 else 1))
+
+    def compact(cand, cap):
+        """Fixed-capacity candidate positions via TWO-LEVEL compaction.
+
+        A direct ``jnp.nonzero`` over the full position axis costs seconds
+        on a 128 MiB segment (measured: the cumsum+scatter over 1.3e8
+        lanes dominates the whole pipeline); packing candidate bits 32:1
+        into u32 words first makes the expensive nonzero 32x smaller, and
+        the second-level expansion works on ``w_cap*32`` lanes only.
+        """
+        rem = (-cand.shape[0]) % 32
+        if rem:
+            cand = jnp.concatenate(
+                [cand, jnp.zeros(rem, dtype=cand.dtype)])
+        words = _pack_bits(cand)
+        nzw = words != 0
+        (widx,) = jnp.nonzero(nzw, size=w_cap, fill_value=words.shape[0])
+        wsafe = jnp.clip(widx, 0, words.shape[0] - 1)
+        bits = words[wsafe]  # (w_cap,) u32, junk where widx overflowed
+        bits = jnp.where(widx < words.shape[0], bits, jnp.uint32(0))
+        lane = jnp.arange(32, dtype=jnp.int32)[None, :]
+        hasbit = ((bits[:, None] >> lane.astype(jnp.uint32)) & 1) == 1
+        posmat = widx[:, None].astype(jnp.int32) * 32 + lane
+        flat_has = hasbit.reshape(-1)
+        flat_pos = jnp.where(flat_has, posmat.reshape(-1), P)
+        (sel,) = jnp.nonzero(flat_has, size=cap, fill_value=flat_pos.shape[0])
+        pos = flat_pos[jnp.clip(sel, 0, flat_pos.shape[0] - 1)]
+        pos = jnp.where(sel < flat_pos.shape[0], pos, P)
+        word_overflow = jnp.sum(nzw.astype(jnp.int32)) > w_cap
+        return pos.astype(jnp.int32), word_overflow
+
     def one(ext, n):
         h = _hash_ext_fast(ext)
         valid = jnp.arange(P, dtype=jnp.int32) < n
@@ -325,11 +359,10 @@ def scan_select_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
         cand_s = cand_l & ((h & ms) == 0)
         n_l = jnp.sum(cand_l.astype(jnp.int32))
         n_s = jnp.sum(cand_s.astype(jnp.int32))
-        overflow = ((n_l > l_cap) | (n_s > s_cap)).astype(jnp.int32)
-        (pos_l,) = jnp.nonzero(cand_l, size=l_cap, fill_value=P)
-        (pos_s,) = jnp.nonzero(cand_s, size=s_cap, fill_value=P)
-        pos_l = pos_l.astype(jnp.int32)
-        pos_s = pos_s.astype(jnp.int32)
+        pos_l, ovf_l = compact(cand_l, l_cap)
+        pos_s, ovf_s = compact(cand_s, s_cap)
+        overflow = ((n_l > l_cap) | (n_s > s_cap)
+                    | ovf_l | ovf_s).astype(jnp.int32)
 
         def cond(st):
             s, k, _ = st

@@ -1,0 +1,117 @@
+"""Pallas/Mosaic TPU kernels for the dedup pipeline hot ops.
+
+The XLA formulation of the gear-table lookup materializes a (N, 256)
+one-hot operand through HBM (~512 bytes of traffic per stream byte); here
+the one-hot never leaves VMEM — each grid program stages 32 KiB of bytes,
+expands+contracts them against the 256x4 limb table on the MXU in 8 KiB
+sub-blocks, and writes only the 4-byte gear value per byte back to HBM.
+
+Kernels gate themselves on the runtime platform: on non-TPU backends the
+callers fall back to the pure-XLA paths (bit-identical by construction;
+asserted by tests/test_pallas.py on the TPU rig).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .gear import GEAR
+
+# bytes handled per grid program / per MXU sub-block
+_TILE_BYTES = 32768
+_SUB_BYTES = 8192
+_LANES = 128
+_TILE_ROWS = _TILE_BYTES // _LANES
+
+_GEAR_LIMBS_F32 = np.stack(
+    [(GEAR >> (8 * j)) & 0xFF for j in range(4)], axis=1).astype(np.float32)
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_available() -> bool:
+    """True when the Pallas TPU lowering is usable on this runtime."""
+    if os.environ.get("BKW_PALLAS", "1") == "0":
+        return False
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:
+        return False
+    if platform not in ("tpu", "axon"):
+        return False
+    try:
+        probe = jnp.zeros(_TILE_BYTES, dtype=jnp.uint8)
+        out = gear_values_pallas(probe)
+        return int(np.asarray(out[0])) == int(GEAR[0])
+    except Exception:  # pragma: no cover - lowering failure on exotic rigs
+        return False
+
+
+def _gear_kernel(b_ref, tab_ref, g_ref):
+    """One grid program: (TILE_ROWS, 128) u8 -> (TILE_ROWS, 128) u32."""
+    sub_rows = _SUB_BYTES // _LANES
+
+    def body(i, carry):
+        blk = b_ref[pl.ds(i * sub_rows, sub_rows), :].astype(jnp.int32)
+        # rank-3 one-hot stays in VMEM; contraction on the MXU.  No
+        # reshapes: Mosaic cannot relayout (rows,128)->(8192,1)
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, (sub_rows, _LANES, 256), 2)
+        oh = (blk[:, :, None] == cols).astype(jnp.bfloat16)
+        limbs = jax.lax.dot_general(
+            oh, tab_ref[:].astype(jnp.bfloat16),
+            dimension_numbers=(((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (sub_rows, 128, 4)
+        # Mosaic lacks f32->u32 casts: go through i32 (limbs are 0..255 so
+        # the cast is exact; the <<24 wrap is the bit pattern we want) and
+        # bitcast to u32 at the store
+        l_ = limbs.astype(jnp.int32)
+        g = (l_[..., 0] | (l_[..., 1] << 8)
+             | (l_[..., 2] << 16) | (l_[..., 3] << 24))
+        g_ref[pl.ds(i * sub_rows, sub_rows), :] = pltpu.bitcast(
+            g, jnp.uint32)
+        return carry
+
+    jax.lax.fori_loop(0, _TILE_ROWS // sub_rows, body, 0)
+
+
+try:  # pallas imports lazily guarded: CPU-only test runs never need them
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+
+
+@jax.jit
+def gear_values_pallas(b: jnp.ndarray) -> jnp.ndarray:
+    """GEAR[b] for a u8 vector via the VMEM-resident one-hot matmul.
+
+    Accepts any length; internally pads to the tile size and slices back.
+    """
+    n = b.shape[0]
+    padded = -(-max(n, 1) // _TILE_BYTES) * _TILE_BYTES
+    if padded != n:
+        b = jnp.concatenate([b, jnp.zeros(padded - n, dtype=jnp.uint8)])
+    rows = padded // _LANES
+    b2 = b.reshape(rows, _LANES)
+    tab = jnp.asarray(_GEAR_LIMBS_F32)
+    grid = rows // _TILE_ROWS
+    g2 = pl.pallas_call(
+        _gear_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.uint32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_TILE_ROWS, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((256, 4), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_TILE_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+    )(b2, tab)
+    return g2.reshape(padded)[:n]
