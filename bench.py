@@ -51,8 +51,8 @@ def main() -> None:
     from backuwup_tpu.ops.gear import CDCParams
     from backuwup_tpu.ops.pipeline import DevicePipeline
 
-    segments = int(os.environ.get("BENCH_SEGMENTS", "4"))
-    seg_mib = int(os.environ.get("BENCH_SEGMENT_MIB", "128"))
+    segments = int(os.environ.get("BENCH_SEGMENTS", "3"))
+    seg_mib = int(os.environ.get("BENCH_SEGMENT_MIB", "256"))
     cpu_mib = int(os.environ.get("BENCH_CPU_MIB", "64"))
     params = CDCParams()  # production 256KiB/1MiB/3MiB
     pipeline = DevicePipeline(params)

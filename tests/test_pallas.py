@@ -1,0 +1,52 @@
+"""Mosaic kernel parity (TPU rig only — the CPU CI mesh skips).
+
+The Pallas kernels are experimental alternates for the scan hot ops
+(PERF.md documents why they are not yet the production path); bit-parity
+against the spec implementations is asserted whenever the lowering is
+available so they can never rot silently.
+"""
+
+import numpy as np
+import pytest
+
+from backuwup_tpu.ops import pallas_kernels as pk
+from backuwup_tpu.ops.gear import GEAR, CDCParams
+
+pytestmark = pytest.mark.skipif(
+    not pk.pallas_available(), reason="no Pallas TPU lowering here")
+
+
+def test_gear_values_parity():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    for n in (1, 255, pk._TILE_BYTES, pk._TILE_BYTES * 3 + 17, 1 << 20):
+        b = rng.integers(0, 256, n, dtype=np.uint8)
+        g = np.asarray(pk.gear_values_pallas(jnp.asarray(b)))
+        assert np.array_equal(g, GEAR[b]), n
+
+
+def test_ladder_candidates_parity():
+    import jax.numpy as jnp
+
+    from backuwup_tpu.ops.cdc_cpu import gear_hashes
+
+    p = CDCParams()
+    block = pk._LADDER_ROWS * pk._LANES
+    rng = np.random.default_rng(8)
+    n = 2 * block
+    data = rng.integers(0, 256, n - 31, dtype=np.uint8)
+    ext = np.zeros(n, dtype=np.uint8)
+    ext[31:] = data
+    g = GEAR[ext].astype(np.uint32)
+    cl, cs = pk.ladder_candidates_pallas(
+        jnp.asarray(g), n, mask_s=p.mask_s, mask_l=p.mask_l)
+    cl = np.asarray(cl)[31:].astype(bool)
+    cs = np.asarray(cs)[31:].astype(bool)
+    # the kernel sees 31 zero BYTES of left context; give the oracle the
+    # identical context so even the warmup positions compare bit-exactly
+    h = gear_hashes(data, prev_tail=bytes(31))
+    cl_ref = (h & np.uint32(p.mask_l)) == 0
+    cs_ref = cl_ref & ((h & np.uint32(p.mask_s)) == 0)
+    assert np.array_equal(cl, cl_ref)
+    assert np.array_equal(cs, cs_ref)
