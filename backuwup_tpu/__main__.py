@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import signal
 import sys
 from pathlib import Path
@@ -61,6 +62,22 @@ async def _run_client(args) -> int:
         elif sys.stdin.isatty() and not args.non_interactive:
             root_secret = ui_cli.first_run_guide()
 
+    # TLS is on by default (reference posture); a loopback server with no
+    # explicit USE_TLS / CA configured is the local-testing case
+    # (docs/src/client.md:22) — default it to plaintext so the
+    # out-of-the-box `server` + `client` pairing connects.
+    import os as _os
+    addr = args.server_addr or _os.environ.get("SERVER_ADDR",
+                                               "127.0.0.1:8080")
+    if args.no_tls:
+        _os.environ["USE_TLS"] = "0"
+    elif "USE_TLS" not in _os.environ \
+            and "TLS_CA_FILE" not in _os.environ \
+            and addr.split(":")[0] in ("127.0.0.1", "localhost", "::1"):
+        print("note: loopback server and no TLS config; using plaintext "
+              "(set USE_TLS=1 or TLS_CA_FILE to force TLS)", flush=True)
+        _os.environ["USE_TLS"] = "0"
+
     app = ClientApp(
         config_dir=args.config_dir and Path(args.config_dir),
         data_dir=args.data_dir and Path(args.data_dir),
@@ -97,8 +114,17 @@ async def _run_server(args) -> int:
     server = CoordinationServer(db_path=args.db)
     host, _, port = args.bind.rpartition(":")
     host = host or "127.0.0.1"
-    port = await server.start(host, int(port))
-    print(f"coordination server listening on {host}:{port}", flush=True)
+    ssl_context = None
+    cert = os.environ.get("TLS_CERT_FILE")
+    key = os.environ.get("TLS_KEY_FILE")
+    if cert and key:
+        import ssl
+        ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ssl_context.load_cert_chain(cert, key)
+    port = await server.start(host, int(port), ssl_context=ssl_context)
+    scheme = "https" if ssl_context else "http"
+    print(f"coordination server listening on {host}:{port} ({scheme})",
+          flush=True)
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -131,6 +157,8 @@ def main(argv=None) -> int:
                    help="recover an identity from this phrase (first run)")
     c.add_argument("--non-interactive", action="store_true",
                    help="never prompt; generate a fresh identity if none")
+    c.add_argument("--no-tls", action="store_true",
+                   help="plaintext control plane (USE_TLS=0)")
 
     s = sub.add_parser("server", help="run the coordination server")
     s.add_argument("--bind", default="127.0.0.1:8100",

@@ -50,6 +50,14 @@ BACKUP_REQUEST_EXPIRY_S = 300.0
 
 # --- p2p transport (reference shared/src/p2p_message.rs:8) ------------------
 MAX_P2P_MESSAGE_SIZE = 8 * MiB
+# Signed-envelope framing budget (P2PBody FILE encoding + Ed25519
+# signature is ~150 bytes; 4 KiB leaves generous slack).  Every file the
+# send pipeline ships must fit one transport message, so the packfile
+# writer's effective cap is the wire max minus this — the analog of the
+# reference's validate_size_constraints proof (pack.rs:257-288), which
+# only had to prove 16 MiB because its transport cap was not smaller.
+P2P_ENVELOPE_OVERHEAD = 4 * KiB
+PACKFILE_WIRE_MAX = MAX_P2P_MESSAGE_SIZE - P2P_ENVELOPE_OVERHEAD
 
 # --- server-side TTLs (reference server/src/client_auth_manager.rs:17-20) ---
 AUTH_CHALLENGE_TTL_S = 30.0

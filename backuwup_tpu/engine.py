@@ -35,7 +35,7 @@ from typing import Dict, Optional
 
 from . import defaults, wire
 from .crypto import KeyManager
-from .net.client import ServerClient, ServerError
+from .net.client import NoBackups, ServerClient, ServerError
 from .net.p2p import P2PError, P2PNode, Receiver, RestoreFilesWriter, Transport
 from .ops.backend import ChunkerBackend, select_backend
 from .snapshot.blob_index import BlobIndex, index_file_name
@@ -95,7 +95,14 @@ class Engine:
         self.index = BlobIndex(keys, self._index_dir())
         self.index.load()
         # with a mesh attached, dedup decisions run batched on the sharded
-        # HBM table; BlobIndex stays the persisted authority + parity oracle
+        # HBM table; BlobIndex stays the persisted authority + parity
+        # oracle.  On an accelerator backend the mesh is attached by
+        # DEFAULT (single axis over every local device) so real runs
+        # exercise the HBM table without caller plumbing (SURVEY §7 3e);
+        # BKW_DEVICE_DEDUP=0 opts out.
+        if dedup_mesh is None and getattr(self.backend, "name", "") == "tpu" \
+                and os.environ.get("BKW_DEVICE_DEDUP", "1") != "0":
+            dedup_mesh = self._default_mesh()
         self.device_dedup = None
         if dedup_mesh is not None:
             from .snapshot.device_dedup import MeshDedupIndex
@@ -106,6 +113,20 @@ class Engine:
         # (restore_orchestrator.rs:45-56); a second start must fail loudly,
         # not corrupt the pack dir with a concurrent packer
         self._exclusive = asyncio.Lock()
+
+    @staticmethod
+    def _default_mesh():
+        """Single-axis mesh over every local device; None off-accelerator."""
+        try:
+            import jax
+            import numpy as _np
+            from jax.sharding import Mesh
+            devices = jax.devices()
+            if not devices:
+                return None
+            return Mesh(_np.array(devices), ("data",))
+        except Exception:
+            return None
 
     # --- paths -------------------------------------------------------------
 
@@ -384,7 +405,10 @@ class Engine:
                 time.time() - last < defaults.RESTORE_REQUEST_THROTTLE_S:
             raise EngineError("restore requested too recently")
         self.store.add_event(EVENT_RESTORE_REQUEST, {})
-        info = await self.server.backup_restore()
+        try:
+            info = await self.server.backup_restore()
+        except NoBackups:
+            raise EngineError("no snapshot recorded on server")
         if info.snapshot_hash is None:
             raise EngineError("no snapshot recorded on server")
         peers = [bytes.fromhex(p) for p in info.peers]

@@ -26,29 +26,94 @@ from ..store import Store
 
 
 class ServerError(Exception):
-    def __init__(self, kind: str, detail: str = ""):
-        super().__init__(f"{kind}: {detail}" if detail else kind)
-        self.kind = kind
+    """Base of the typed error taxonomy (wire.ErrorKind; the reference
+    client pattern-matches the 8 ErrorType variants,
+    server_message.rs:43-54)."""
+
+    KIND = wire.ErrorKind.FAILURE
+
+    def __init__(self, detail: str = "", kind: str = None):
+        self.kind = kind or self.KIND
+        self.detail = detail
+        super().__init__(f"{self.kind}: {detail}" if detail else self.kind)
 
 
 class Unauthorized(ServerError):
-    def __init__(self, detail: str = ""):
-        super().__init__("Unauthorized", detail)
+    KIND = wire.ErrorKind.UNAUTHORIZED
+
+
+class ClientNotFound(ServerError):
+    KIND = wire.ErrorKind.CLIENT_NOT_FOUND
+
+
+class DestinationUnreachable(ServerError):
+    KIND = wire.ErrorKind.DESTINATION_UNREACHABLE
+
+
+class NoBackups(ServerError):
+    KIND = wire.ErrorKind.NO_BACKUPS
+
+
+class RetryLater(ServerError):
+    KIND = wire.ErrorKind.RETRY
+
+
+class BadRequest(ServerError):
+    KIND = wire.ErrorKind.BAD_REQUEST
+
+
+class ClientExists(BadRequest):
+    """409-status BadRequest: the identity is already registered (the
+    restore-from-phrase path hits this and proceeds to login)."""
+
+
+class ServerFault(ServerError):
+    KIND = wire.ErrorKind.SERVER_ERROR
+
+
+_KIND_TO_EXC = {
+    wire.ErrorKind.UNAUTHORIZED: Unauthorized,
+    wire.ErrorKind.CLIENT_NOT_FOUND: ClientNotFound,
+    wire.ErrorKind.DESTINATION_UNREACHABLE: DestinationUnreachable,
+    wire.ErrorKind.NO_BACKUPS: NoBackups,
+    wire.ErrorKind.RETRY: RetryLater,
+    wire.ErrorKind.BAD_REQUEST: BadRequest,
+    wire.ErrorKind.SERVER_ERROR: ServerFault,
+    wire.ErrorKind.FAILURE: ServerError,
+}
 
 
 def server_addr() -> str:
     return os.environ.get("SERVER_ADDR", "127.0.0.1:8080")
 
 
+def use_tls() -> bool:
+    """TLS-by-default with a USE_TLS=0 off-switch for local testing,
+    mirroring client/src/defaults.rs:6-7 + requests.rs:246-258."""
+    return os.environ.get("USE_TLS", "1") not in ("0", "false", "no")
+
+
+def _ssl_client_context():
+    """Client-side SSL context; TLS_CA_FILE pins a (self-signed) CA."""
+    import ssl
+
+    ca = os.environ.get("TLS_CA_FILE")
+    if ca:
+        return ssl.create_default_context(cafile=ca)
+    return ssl.create_default_context()
+
+
 class ServerClient:
     """One client's control-plane connection to the coordination server."""
 
     def __init__(self, keys: KeyManager, store: Store,
-                 addr: Optional[str] = None):
+                 addr: Optional[str] = None, tls: Optional[bool] = None):
         self.keys = keys
         self.store = store
         self.addr = addr or server_addr()
-        self.base = f"http://{self.addr}"
+        self.tls = use_tls() if tls is None else tls
+        scheme = "https" if self.tls else "http"
+        self.base = f"{scheme}://{self.addr}"
         self._http: Optional[aiohttp.ClientSession] = None
         self._ws_task: Optional[asyncio.Task] = None
         self.on_backup_matched: Optional[Callable] = None
@@ -58,7 +123,11 @@ class ServerClient:
 
     async def _session(self) -> aiohttp.ClientSession:
         if self._http is None or self._http.closed:
-            self._http = aiohttp.ClientSession()
+            if self.tls:
+                connector = aiohttp.TCPConnector(ssl=_ssl_client_context())
+                self._http = aiohttp.ClientSession(connector=connector)
+            else:
+                self._http = aiohttp.ClientSession()
         return self._http
 
     async def close(self) -> None:
@@ -81,12 +150,15 @@ class ServerClient:
             try:
                 out = wire.JsonMessage.from_json(body)
             except ValueError:
-                out = wire.Error(kind="BadResponse", detail=body[:200])
-            if resp.status == 401:
-                raise Unauthorized(getattr(out, "detail", ""))
+                out = wire.Error(kind=wire.ErrorKind.FAILURE,
+                                 detail=f"unparseable response: {body[:200]}")
             if resp.status >= 400 or isinstance(out, wire.Error):
-                kind = getattr(out, "kind", f"HTTP{resp.status}")
-                raise ServerError(kind, getattr(out, "detail", ""))
+                kind = getattr(out, "kind", wire.ErrorKind.FAILURE)
+                detail = getattr(out, "detail", "")
+                if resp.status == 409 and kind == wire.ErrorKind.BAD_REQUEST:
+                    raise ClientExists(detail)
+                exc = _KIND_TO_EXC.get(kind, ServerError)
+                raise exc(detail)
             return out
 
     # --- identity flows (identity.rs) --------------------------------------
@@ -95,9 +167,16 @@ class ServerClient:
         challenge = await self._post("/register/begin",
                                      wire.ClientRegistrationRequest(
                                          pubkey=self.keys.client_id))
-        await self._post("/register/complete", wire.ClientRegistrationAuth(
-            pubkey=self.keys.client_id,
-            challenge_response=self.keys.sign(challenge.nonce)))
+        try:
+            await self._post("/register/complete",
+                             wire.ClientRegistrationAuth(
+                                 pubkey=self.keys.client_id,
+                                 challenge_response=self.keys.sign(
+                                     challenge.nonce)))
+        except ClientExists:
+            # a recovered identity (restore-from-phrase) is already
+            # registered; proceed to login (identity.rs:46-69)
+            pass
 
     async def login(self) -> bytes:
         challenge = await self._post("/login/begin", wire.ClientLoginRequest(

@@ -131,11 +131,14 @@ class AuthManager:
             nonce, time.time() + defaults.AUTH_CHALLENGE_TTL_S)
         return nonce
 
-    def challenge_verify(self, pubkey: bytes, signature: bytes) -> bool:
+    def take_challenge(self, pubkey: bytes) -> Optional[bytes]:
+        """Pop a live challenge nonce; None when absent/expired (the
+        reference distinguishes ChallengeNotFound -> Retry from a bad
+        signature -> BadRequest, handlers/mod.rs:52-76)."""
         entry = self._challenges.pop(pubkey, None)
         if entry is None or entry[1] < time.time():
-            return False
-        return verify_signature(pubkey, entry[0], signature)
+            return None
+        return entry[0]
 
     def session_start(self, pubkey: bytes) -> bytes:
         token = os.urandom(wire.SESSION_TOKEN_LEN)
@@ -278,11 +281,24 @@ class CoordinationServer:
 
     # --- helpers -----------------------------------------------------------
 
+    _STATUS_EXC = {400: web.HTTPBadRequest, 401: web.HTTPUnauthorized,
+                   404: web.HTTPNotFound, 409: web.HTTPConflict,
+                   500: web.HTTPInternalServerError}
+
+    @staticmethod
+    def _err(kind: str, detail: str = "",
+             status: Optional[int] = None) -> web.HTTPException:
+        """Typed error response: one of the 8 wire.ErrorKind payloads at
+        its mapped HTTP status (handlers/mod.rs:50-91)."""
+        status = status or wire.ERROR_HTTP_STATUS[kind]
+        exc = CoordinationServer._STATUS_EXC[status]
+        return exc(text=wire.Error(kind=kind, detail=detail).to_json(),
+                   content_type="application/json")
+
     def _session(self, msg) -> bytes:
         client = self.auth.get_session(msg.session_token)
         if client is None:
-            raise web.HTTPUnauthorized(
-                text=wire.Error(kind="Unauthorized").to_json())
+            raise self._err(wire.ErrorKind.UNAUTHORIZED)
         return client
 
     @staticmethod
@@ -290,12 +306,10 @@ class CoordinationServer:
         try:
             msg = wire.JsonMessage.from_json(await request.text())
         except (ValueError, KeyError) as e:
-            raise web.HTTPBadRequest(
-                text=wire.Error(kind="BadRequest", detail=str(e)).to_json())
+            raise CoordinationServer._err(wire.ErrorKind.BAD_REQUEST, str(e))
         if not isinstance(msg, cls):
-            raise web.HTTPBadRequest(
-                text=wire.Error(kind="BadRequest",
-                                detail=f"expected {cls.__name__}").to_json())
+            raise CoordinationServer._err(
+                wire.ErrorKind.BAD_REQUEST, f"expected {cls.__name__}")
         return msg
 
     @staticmethod
@@ -312,25 +326,35 @@ class CoordinationServer:
 
     async def register_complete(self, request):
         msg = await self._parse(request, wire.ClientRegistrationAuth)
-        if not self.auth.challenge_verify(msg.pubkey, msg.challenge_response):
-            raise web.HTTPUnauthorized(
-                text=wire.Error(kind="ChallengeFailed").to_json())
+        nonce = self.auth.take_challenge(msg.pubkey)
+        if nonce is None:
+            # expired/unknown challenge: the client should restart the
+            # flow (ChallengeNotFound -> Retry, handlers/mod.rs:73)
+            raise self._err(wire.ErrorKind.RETRY)
+        if not verify_signature(msg.pubkey, nonce, msg.challenge_response):
+            raise self._err(wire.ErrorKind.BAD_REQUEST, "bad signature")
+        if self.db.client_exists(msg.pubkey):
+            # 409 CONFLICT with a BadRequest payload (ClientExists,
+            # handlers/mod.rs:66,79)
+            raise self._err(wire.ErrorKind.BAD_REQUEST,
+                            "client already exists", status=409)
         self.db.register_client(msg.pubkey)
         return self._ok()
 
     async def login_begin(self, request):
         msg = await self._parse(request, wire.ClientLoginRequest)
         if not self.db.client_exists(msg.pubkey):
-            raise web.HTTPUnauthorized(
-                text=wire.Error(kind="UnknownClient").to_json())
+            raise self._err(wire.ErrorKind.CLIENT_NOT_FOUND)
         return self._ok(wire.ServerChallenge(
             nonce=self.auth.challenge_begin(msg.pubkey)))
 
     async def login_complete(self, request):
         msg = await self._parse(request, wire.ClientLoginAuth)
-        if not self.auth.challenge_verify(msg.pubkey, msg.challenge_response):
-            raise web.HTTPUnauthorized(
-                text=wire.Error(kind="ChallengeFailed").to_json())
+        nonce = self.auth.take_challenge(msg.pubkey)
+        if nonce is None:
+            raise self._err(wire.ErrorKind.RETRY)
+        if not verify_signature(msg.pubkey, nonce, msg.challenge_response):
+            raise self._err(wire.ErrorKind.BAD_REQUEST, "bad signature")
         self.db.client_update_logged_in(msg.pubkey)
         return self._ok(wire.LoginToken(token=self.auth.session_start(msg.pubkey)))
 
@@ -340,8 +364,7 @@ class CoordinationServer:
         try:
             await self.queue.fulfill(client, msg.storage_required)
         except ValueError as e:
-            raise web.HTTPBadRequest(
-                text=wire.Error(kind="BadRequest", detail=str(e)).to_json())
+            raise self._err(wire.ErrorKind.BAD_REQUEST, str(e))
         return self._ok()
 
     async def backup_done(self, request):
@@ -354,6 +377,9 @@ class CoordinationServer:
         msg = await self._parse(request, wire.BackupRestoreRequest)
         client = self._session(msg)
         snapshot = self.db.get_latest_client_snapshot(client)
+        if snapshot is None:
+            # NoBackupsAvailable -> 404 NoBackups (handlers/backup.rs:30-38)
+            raise self._err(wire.ErrorKind.NO_BACKUPS)
         peers = self.db.get_client_negotiated_peers(client)
         return self._ok(wire.BackupRestoreInfo(
             snapshot_hash=snapshot, peers=[p.hex() for p in peers]))
@@ -365,8 +391,7 @@ class CoordinationServer:
             msg.destination_client_id, wire.IncomingP2PConnection(
                 source_client_id=client, session_nonce=msg.session_nonce))
         if not delivered:
-            raise web.HTTPNotFound(
-                text=wire.Error(kind="DestinationOffline").to_json())
+            raise self._err(wire.ErrorKind.DESTINATION_UNREACHABLE)
         return self._ok()
 
     async def p2p_confirm(self, request):
@@ -377,8 +402,7 @@ class CoordinationServer:
                 destination_client_id=client,
                 destination_ip_address=msg.destination_ip_address))
         if not delivered:
-            raise web.HTTPNotFound(
-                text=wire.Error(kind="DestinationOffline").to_json())
+            raise self._err(wire.ErrorKind.DESTINATION_UNREACHABLE)
         return self._ok()
 
     async def ws(self, request):
@@ -386,10 +410,10 @@ class CoordinationServer:
         try:
             token_bytes = bytes.fromhex(token) if token else None
         except ValueError:
-            raise web.HTTPUnauthorized()
+            raise self._err(wire.ErrorKind.UNAUTHORIZED, "malformed token")
         client = self.auth.get_session(token_bytes)
         if client is None:
-            raise web.HTTPUnauthorized()
+            raise self._err(wire.ErrorKind.UNAUTHORIZED)
         ws = web.WebSocketResponse(heartbeat=30)
         await ws.prepare(request)
         self.connections.register(client, ws)
@@ -419,10 +443,14 @@ class CoordinationServer:
         ])
         return app
 
-    async def start(self, host="127.0.0.1", port=0) -> int:
+    async def start(self, host="127.0.0.1", port=0,
+                    ssl_context=None) -> int:
+        """Serve; with ``ssl_context`` the control plane is HTTPS/WSS (the
+        reference is TLS-by-default with a USE_TLS off-switch for local
+        testing, requests.rs:246-258, docs/src/client.md:22)."""
         self._runner = web.AppRunner(self.app())
         await self._runner.setup()
-        site = web.TCPSite(self._runner, host, port)
+        site = web.TCPSite(self._runner, host, port, ssl_context=ssl_context)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]
         return self.port

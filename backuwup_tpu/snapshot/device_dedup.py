@@ -64,8 +64,22 @@ class MeshDedupIndex:
                 np.ones(len(batch), dtype=np.uint32))
 
     def _grow(self) -> None:
-        self.capacity *= 2
-        self._rebuild()
+        # 4x jump + on-device migration: the resident keys re-hash into
+        # the bigger table without ever crossing the host link, and the
+        # geometric step keeps total migration work O(N) amortized over
+        # all inserts (the old path re-uploaded every known hash through
+        # _SEED_BATCH chunks on every doubling).  If migration itself
+        # exhausts probes (pathological clustering), keep growing — the
+        # old table is left intact by a failed grown(), so state stays
+        # consistent.
+        cap = self.capacity * 4
+        while True:
+            try:
+                self.sharded = self.sharded.grown(cap)
+                break
+            except DedupIndexFull:
+                cap *= 4
+        self.capacity = cap
 
     def classify_insert(self, hashes: List[bytes]) -> List[bool]:
         """is-duplicate flag per hash; new hashes become table-resident.
@@ -85,20 +99,27 @@ class MeshDedupIndex:
                 uniq.append(h)
         q = hashes_to_queries(uniq)
         vals = np.ones(len(uniq), dtype=np.uint32)
+        interrupted = False
         while True:
             try:
                 found = self.sharded.insert(q, vals)
                 break
             except DedupIndexFull:
-                # all previously classified hashes are host-known by the
-                # time the next classify runs, so reseed-from-host plus a
-                # retry of this batch loses nothing
+                # the failed attempt may have scattered part of the batch
+                # before probing exhausted; after the on-device migration
+                # the retry would see those keys as resident, so the
+                # batch's verdicts are resolved against the host authority
+                # below (which still reflects only prior batches)
                 self._grow()
+                interrupted = True
         flags: List[bool] = []
         seen: set = set()
         for h in hashes:
             if h in seen:
                 flags.append(True)
+            elif interrupted:
+                seen.add(h)
+                flags.append(self.host.is_duplicate(h))
             else:
                 seen.add(h)
                 flags.append(bool(found[first[h]] > 0))

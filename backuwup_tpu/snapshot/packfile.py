@@ -137,14 +137,19 @@ class PackfileWriter:
         nonce = os.urandom(NONCE_LEN)
         ct = AESGCM(key).encrypt(nonce, comp, None)
         record = nonce + ct
-        if self._file_size(1, len(record)) > defaults.PACKFILE_MAX_SIZE:
+        # the binding cap is the smaller of the format cap (16 MiB,
+        # packfile/mod.rs:27) and what one signed transport message can
+        # carry (defaults.PACKFILE_WIRE_MAX) — a packfile that cannot be
+        # sent would strand the backup
+        cap = min(defaults.PACKFILE_MAX_SIZE, defaults.PACKFILE_WIRE_MAX)
+        if self._file_size(1, len(record)) > cap:
             raise PackfileError("single blob exceeds packfile max size")
         # hard cap is enforced *before* anything hits disk: flush the current
-        # batch if this blob would push the file over PACKFILE_MAX_SIZE
+        # batch if this blob would push the file over the cap
         if self._pending and (
                 self._file_size(len(self._pending) + 1,
                                 self._pending_ct + len(record))
-                > defaults.PACKFILE_MAX_SIZE):
+                > cap):
             self._write_packfile()
         header = PackfileHeaderBlob(
             hash=blob.hash, kind=blob.kind, compression=comp_kind,
@@ -195,7 +200,9 @@ class PackfileWriter:
         self._pending = []
         self._pending_plain = 0
         self._pending_ct = 0
-        assert size <= defaults.PACKFILE_MAX_SIZE, "cap enforced in add_blob"
+        assert size <= min(defaults.PACKFILE_MAX_SIZE,
+                           defaults.PACKFILE_WIRE_MAX), \
+            "cap enforced in add_blob"
         if self.on_packfile is not None:
             self.on_packfile(packfile_id, path, hashes, size)
 

@@ -316,12 +316,51 @@ class BackupRestoreInfo(JsonMessage):
     _bytes_fields = {"snapshot_hash": BLOB_HASH_LEN}
 
 
+class ErrorKind:
+    """The closed error taxonomy, mirroring the reference's 8 ``ErrorType``
+    variants (shared/src/server_message.rs:43-54); payload details ride in
+    :class:`Error.detail` (the reference embeds strings in three of them).
+    """
+
+    UNAUTHORIZED = "Unauthorized"
+    CLIENT_NOT_FOUND = "ClientNotFound"
+    DESTINATION_UNREACHABLE = "DestinationUnreachable"
+    NO_BACKUPS = "NoBackups"
+    RETRY = "Retry"
+    BAD_REQUEST = "BadRequest"
+    SERVER_ERROR = "ServerError"
+    FAILURE = "Failure"
+
+    ALL = (UNAUTHORIZED, CLIENT_NOT_FOUND, DESTINATION_UNREACHABLE,
+           NO_BACKUPS, RETRY, BAD_REQUEST, SERVER_ERROR, FAILURE)
+
+
+# kind -> HTTP status, per the reference's ResponseError mapping
+# (server/src/handlers/mod.rs:50-91); ClientExists keeps the reference's
+# 409 CONFLICT status with a BAD_REQUEST payload.
+ERROR_HTTP_STATUS = {
+    ErrorKind.UNAUTHORIZED: 401,
+    ErrorKind.CLIENT_NOT_FOUND: 404,
+    ErrorKind.DESTINATION_UNREACHABLE: 404,
+    ErrorKind.NO_BACKUPS: 404,
+    ErrorKind.RETRY: 404,
+    ErrorKind.BAD_REQUEST: 400,
+    ErrorKind.SERVER_ERROR: 500,
+    ErrorKind.FAILURE: 500,
+}
+
+
 @dataclass
 class Error(JsonMessage):
-    # reference ErrorType has 8 variants (server_message.rs:22-40); carried as
-    # a string kind plus human-readable detail.
-    kind: str = "Failure"
+    # one of ErrorKind.ALL plus a human-readable detail
+    kind: str = ErrorKind.FAILURE
     detail: str = ""
+
+    def __post_init__(self) -> None:
+        if self.kind not in ErrorKind.ALL:
+            self.detail = (f"{self.kind}: {self.detail}"
+                           if self.detail else self.kind)
+            self.kind = ErrorKind.FAILURE
 
 
 # server -> client WS push (reference shared/src/server_message_ws.rs:9-35)

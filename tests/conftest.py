@@ -8,6 +8,9 @@ on host-platform virtual devices (SURVEY.md section 7 / the driver's
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# plaintext loopback for the suite (the reference's local-testing posture,
+# docs/src/client.md:22); tests/test_tls.py opts back in with real certs
+os.environ.setdefault("USE_TLS", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

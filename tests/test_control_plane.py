@@ -234,8 +234,9 @@ def test_restore_info(tmp_path, loop):
         a = _client(tmp_path, "a", port)
         await a.register()
         await a.login()
-        info = await a.backup_restore()
-        assert info.snapshot_hash is None and info.peers == []
+        from backuwup_tpu.net.client import NoBackups
+        with pytest.raises(NoBackups):
+            await a.backup_restore()
         await a.backup_done(b"\x05" * 32)
         server.db.save_storage_negotiated(a.keys.client_id, b"\x09" * 32, 100)
         info = await a.backup_restore()

@@ -17,6 +17,7 @@ REPO = Path(__file__).resolve().parent.parent
 def _spawn(args, env_extra=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("USE_TLS", "0")
     env.update(env_extra or {})
     return subprocess.Popen(
         [sys.executable, "-m", "backuwup_tpu", *args],

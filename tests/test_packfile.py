@@ -154,12 +154,14 @@ def test_hard_cap_enforced_before_write(writer_env, nprng):
     """A near-max blob after buffered data must flush first, never produce
     an oversized file."""
     w, written, _ = writer_env
+    cap = min(defaults.PACKFILE_MAX_SIZE, defaults.PACKFILE_WIRE_MAX)
     w.add_blob(_blob(nprng.integers(0, 256, 2 << 20, dtype="u1").tobytes()))
-    big = nprng.integers(0, 256, 14 << 20, dtype="u1").tobytes()
+    big = nprng.integers(0, 256, 7 << 20, dtype="u1").tobytes()
     w.add_blob(_blob(big))
     w.flush()
     assert len(written) >= 2
     for _, path, _, size in written:
-        assert size <= defaults.PACKFILE_MAX_SIZE
+        assert size <= cap
+    # a single blob that cannot fit any sendable packfile is refused
     with pytest.raises(Exception):
-        w.add_blob(_blob(nprng.integers(0, 256, 17 << 20, dtype="u1").tobytes()))
+        w.add_blob(_blob(nprng.integers(0, 256, 9 << 20, dtype="u1").tobytes()))
