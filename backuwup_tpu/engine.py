@@ -42,6 +42,7 @@ from .snapshot.blob_index import BlobIndex, index_file_name
 from .snapshot.packer import DirPacker
 from .snapshot.packfile import PackfileReader, PackfileWriter, packfile_path
 from .store import EVENT_BACKUP, EVENT_RESTORE_REQUEST, Store
+from .utils import tracing
 
 
 class EngineError(Exception):
@@ -214,7 +215,9 @@ class Engine:
                                should_pause=orch.block_if_paused,
                                dedup_batch=(self.device_dedup.classify_insert
                                             if self.device_dedup else None))
-            snapshot_holder["hash"] = packer.pack(root)
+            with tracing.span("engine.pack"), \
+                    tracing.jax_profiler("backup_pack"):
+                snapshot_holder["hash"] = packer.pack(root)
             snapshot_holder["stats"] = packer.stats
 
         pack_fut = loop.run_in_executor(None, pack_thread)
@@ -238,6 +241,8 @@ class Engine:
             "size": snapshot_holder["stats"].bytes_read,
             "snapshot": snapshot.hex()})
         self._log(f"backup finished: {snapshot.hex()}")
+        if tracing.enabled():
+            self._log("trace spans:\n" + tracing.format_report())
         return snapshot
 
     def _pack_progress(self, **kw) -> None:

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import defaults
+from ..utils import tracing
 from .blake3_tpu import blake3_many_tpu, digest_padded
 from .cdc_cpu import chunk_stream as chunk_stream_cpu
 from .cdc_cpu import cuts_to_chunks, select_cuts
@@ -186,11 +187,12 @@ class DevicePipeline:
         p = self.params
         padded = int(buf_d.shape[1]) - _HALO
         s_cap, l_cap, cut_cap = self._caps(padded)
-        packed_d = scan_select_batch(
-            buf_d, self._nv_device(nv),
-            min_size=p.min_size, desired_size=p.desired_size,
-            max_size=p.max_size, mask_s=p.mask_s, mask_l=p.mask_l,
-            s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap)
+        with tracing.span("pipeline.scan_select_dispatch"):
+            packed_d = scan_select_batch(
+                buf_d, self._nv_device(nv),
+                min_size=p.min_size, desired_size=p.desired_size,
+                max_size=p.max_size, mask_s=p.mask_s, mask_l=p.mask_l,
+                s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap)
         _async_to_host(packed_d)
         return packed_d
 
@@ -203,7 +205,8 @@ class DevicePipeline:
         re-chunked with the CPU oracle to stay bit-identical, unless
         ``strict_overflow`` (benchmarks must never silently time the
         oracle)."""
-        packed = np.asarray(packed_d)
+        with tracing.span("pipeline.cut_collect"):
+            packed = np.asarray(packed_d)
         nv = np.asarray(nv, dtype=np.int32)
         per_row: List[List[tuple]] = []
         for r in range(packed.shape[0]):
@@ -266,8 +269,10 @@ class DevicePipeline:
             _pad_to(np.concatenate(lens_parts), total),
             _pad_to(starts, total)]))
         acc = jnp.zeros((total, 8), dtype=jnp.uint32)
-        for i, (_st, Bb, Lb, _tags) in enumerate(tiles):
-            acc = _gather_digest(flat, meta, meta[2, i], acc, B=Bb, L=Lb)
+        with tracing.span("pipeline.digest_dispatch"):
+            for i, (_st, Bb, Lb, _tags) in enumerate(tiles):
+                acc = _gather_digest(flat, meta, meta[2, i], acc,
+                                     B=Bb, L=Lb)
         _async_to_host(acc)
         return acc, tiles
 
@@ -279,7 +284,8 @@ class DevicePipeline:
             return [(chunks, np.zeros((0, 32), dtype=np.uint8))
                     for chunks in per_row]
         acc, tiles = pending
-        allcv = np.asarray(acc)
+        with tracing.span("pipeline.digest_collect"):
+            allcv = np.asarray(acc)
         dig8 = np.ascontiguousarray(allcv.astype("<u4")).view(
             np.uint8).reshape(-1, 32)
         digests_per_row = [np.zeros((len(c), 32), dtype=np.uint8)

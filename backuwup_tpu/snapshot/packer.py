@@ -28,6 +28,7 @@ from typing import Callable, List, Optional
 from .. import defaults
 from ..ops.backend import ChunkerBackend
 from ..ops.blake3_cpu import blake3_hash
+from ..utils import tracing
 from ..wire import Blob, BlobKind, Tree, TreeKind, TreeMetadata
 from .blob_index import BlobIndex
 from .packfile import PackfileWriter
@@ -133,7 +134,8 @@ class DirPacker:
         def flush_batch():
             if not batch_idx:
                 return
-            manifests = self.backend.manifest_many(batch_data)
+            with tracing.span("packer.manifest_many"):
+                manifests = self.backend.manifest_many(batch_data)
             hints = iter(())
             if self.dedup_batch is not None:
                 # blobs classified host-side since the last batch (streamed
@@ -190,18 +192,47 @@ class DirPacker:
 
     def _pack_file_streaming(self, path: Path, st: os.stat_result) -> bytes:
         """Chunk one huge file through the backend's streaming manifest;
-        blobs pack as chunks finalize, so memory stays ~one segment."""
+        blobs pack as chunks finalize, so memory stays ~one segment.
+
+        The file is mmapped and fed as zero-copy memoryview windows
+        (dir_packer.rs:252's memmap2 analog) — bytes are only copied when
+        they stage into a device buffer or a packfile record.  The same
+        documented race as the reference applies: a file mutating
+        mid-chunk produces a wrong (detectably inconsistent) backup of
+        that file, never a crash.
+        """
+        import mmap as _mmap
+
         children: List[bytes] = []
 
         def emit(ref, data):
             self.stats.chunks += 1
             self.stats.bytes_read += ref.length
             children.append(ref.hash)
-            self._add_blob(ref.hash, BlobKind.FILE_CHUNK, data)
+            self._add_blob(ref.hash, BlobKind.FILE_CHUNK, bytes(data))
 
         with open(path, "rb") as f:
-            self.backend.manifest_stream(
-                f.read, segment_bytes=self.batch_bytes, emit=emit)
+            size = st.st_size
+            if size > 0:
+                with _mmap.mmap(f.fileno(), 0,
+                                access=_mmap.ACCESS_READ) as mm:
+                    view = memoryview(mm)
+                    pos = 0
+
+                    def read(n: int):
+                        nonlocal pos
+                        out = view[pos:pos + n]
+                        pos += len(out)
+                        return out
+
+                    try:
+                        self.backend.manifest_stream(
+                            read, segment_bytes=self.batch_bytes, emit=emit)
+                    finally:
+                        view.release()
+            else:
+                self.backend.manifest_stream(
+                    f.read, segment_bytes=self.batch_bytes, emit=emit)
         self.stats.files += 1
         self.progress(file=str(path), bytes=st.st_size)
         return self._tree_with_split(

@@ -160,15 +160,23 @@ def main() -> None:
         cpu_s = time.time() - t0
         cpu_mibs = cpu_mib / cpu_s
 
+    # --- BASELINE configs #2-#5 -------------------------------------------
+    configs = {}
+    if os.environ.get("BENCH_CONFIGS", "1") != "0":
+        import bench_configs
+
+        configs = bench_configs.run_all(pipeline, params, cpu_mibs, log)
+
     print(json.dumps({
         "metric": "dedup pipeline chunk+hash throughput (device-resident)",
         "value": round(tpu_mibs, 2),
         "unit": "MiB/s",
         "vs_baseline": round(tpu_mibs / cpu_mibs, 2),
         "baseline": f"{baseline_kind} ({cpu_mibs:.1f} MiB/s)",
+        "configs": configs,
         "note": "corpus synthesized on-device (host<->device relay tunnel "
                 "~6 MiB/s would measure the tunnel, not the kernels); "
-                "parity vs CPU oracle gated above",
+                "parity vs CPU oracle gated per config",
     }))
 
 

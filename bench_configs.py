@@ -1,0 +1,295 @@
+"""BASELINE.md benchmark configs #2-#5 (config #1 is bench.py's main loop).
+
+Each config times the production device pipeline on a device-synthesized
+corpus shaped like the BASELINE workload and gates the numbers on
+bit-parity with the CPU oracle over a small downloaded subset (speed
+without identical dedup output is meaningless):
+
+  #2  many small files    — the vmapped per-directory batch path
+  #3  two-snapshot overlap — incremental re-chunk, high dedup
+  #4  large stream         — 64 KiB average chunks (VM-image profile)
+  #5  cross-peer global dedup — sharded HBM index over the device mesh
+
+Environment knobs: BENCH_C2_MIB, BENCH_C3_MIB, BENCH_C4_MIB, BENCH_C5_HASHES.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from backuwup_tpu.ops import cdc_cpu
+from backuwup_tpu.ops.blake3_cpu import Blake3Numpy
+from backuwup_tpu.ops.cdc_tpu import _HALO, _segment_bucket
+from backuwup_tpu.ops.gear import CDCParams
+from backuwup_tpu.ops.pipeline import DevicePipeline
+
+
+def _oracle(data: bytes, params: CDCParams):
+    chunks = cdc_cpu.chunk_stream(data, params)
+    digests = Blake3Numpy().digest_batch(
+        [data[o:o + l] for o, l in chunks])
+    return chunks, digests
+
+
+def _check(device_result, data: bytes, params: CDCParams, tag: str):
+    chunks, digests = device_result
+    ref_chunks, ref_digests = _oracle(data, params)
+    if chunks != ref_chunks or [bytes(d) for d in digests] != ref_digests:
+        raise RuntimeError(f"config {tag}: device/oracle parity FAILED")
+
+
+@functools.partial(jax.jit, static_argnames=("P",))
+def _stage_rows(big: jnp.ndarray, offs: jnp.ndarray, lens: jnp.ndarray,
+                *, P: int) -> jnp.ndarray:
+    """Carve (B,) spans of a resident random pool into halo-padded rows."""
+
+    def one(off, ln):
+        sl = jax.lax.dynamic_slice(big, (off,), (P,))
+        sl = jnp.where(jnp.arange(P, dtype=jnp.int32) < ln, sl, jnp.uint8(0))
+        return jnp.concatenate([jnp.zeros(_HALO, dtype=jnp.uint8), sl])
+
+    return jax.vmap(one)(offs.astype(jnp.int32), lens.astype(jnp.int32))
+
+
+def config2_small_files(pipeline: DevicePipeline, params: CDCParams,
+                        log: Callable) -> Dict:
+    """Many small files, batched — BASELINE config #2."""
+    total_mib = int(os.environ.get("BENCH_C2_MIB", "128"))
+    rng = np.random.default_rng(21)
+    sizes = []
+    left = total_mib << 20
+    while left > 0:
+        n = int(rng.integers(4 << 10, 192 << 10))
+        sizes.append(min(n, left))
+        left -= n
+    pool_len = (total_mib << 20) + (256 << 10)
+    pool = jax.random.randint(jax.random.PRNGKey(5), (pool_len,), 0, 256,
+                              dtype=jnp.uint8)
+    offs = np.zeros(len(sizes), dtype=np.int64)
+    pos = 0
+    for i, s in enumerate(sizes):
+        offs[i] = pos
+        pos += s
+
+    # bucket by padded length like manifest_batch, stage on device
+    groups: Dict[int, list] = {}
+    for i, s in enumerate(sizes):
+        groups.setdefault(_segment_bucket(s), []).append(i)
+    batches = []
+    parts = []
+    for P, idxs in sorted(groups.items()):
+        row = _HALO + P
+        b_cap = max(1, (128 << 20) // row)
+        b_cap = 1 << (b_cap.bit_length() - 1)
+        for s0 in range(0, len(idxs), b_cap):
+            part = idxs[s0:s0 + b_cap]
+            B = min(8, b_cap)
+            while B < len(part):
+                B *= 2
+            o = np.zeros(B, dtype=np.int64)
+            ln = np.zeros(B, dtype=np.int32)
+            for r, i in enumerate(part):
+                o[r], ln[r] = offs[i], sizes[i]
+            buf = _stage_rows(pool, jnp.asarray(o), jnp.asarray(ln), P=P)
+            batches.append((buf, ln))
+            parts.append(part)
+    jax.block_until_ready([b for b, _ in batches])
+
+    # warm (compiles for these shapes), then timed pipelined run
+    list(pipeline.manifest_segments(batches[:1]))
+    t0 = time.time()
+    results = list(pipeline.manifest_segments(batches))
+    dt = time.time() - t0
+    mibs = total_mib / dt
+
+    # parity on the first batch's first rows (~1 MiB download)
+    buf0, ln0 = batches[0]
+    taken = 0
+    for r in range(len(parts[0])):
+        if taken > (1 << 20):
+            break
+        data = bytes(np.asarray(buf0[r, _HALO:_HALO + int(ln0[r])]))
+        _check(results[0][r], data, params, "#2")
+        taken += len(data)
+    n_files = len(sizes)
+    log(f"config#2 small-files: {n_files} files, {total_mib} MiB in "
+        f"{dt:.2f}s = {mibs:.1f} MiB/s")
+    return {"files": n_files, "mib_s": round(mibs, 2)}
+
+
+def config3_incremental(pipeline: DevicePipeline, params: CDCParams,
+                        log: Callable) -> Dict:
+    """Two consecutive snapshots with small edits — BASELINE config #3."""
+    seg_mib = int(os.environ.get("BENCH_C3_MIB", "128"))
+    seg = seg_mib << 20
+    row = _HALO + seg
+    key = jax.random.PRNGKey(31)
+
+    @jax.jit
+    def synth(key):
+        s = jax.random.randint(key, (seg,), 0, 256, dtype=jnp.uint8)
+        return jnp.concatenate([jnp.zeros(_HALO, dtype=jnp.uint8), s]
+                               ).reshape(1, row)
+
+    @jax.jit
+    def edit(buf, key):
+        """Overwrite 20 x 4 KiB windows — the incremental delta."""
+        flat = buf.reshape(-1)
+        ks = jax.random.split(key, 20)
+        offs = jax.random.randint(key, (20,), _HALO, row - 4096)
+        for i in range(20):
+            patch = jax.random.randint(ks[i], (4096,), 0, 256,
+                                       dtype=jnp.uint8)
+            flat = jax.lax.dynamic_update_slice(flat, patch, (offs[i],))
+        return flat.reshape(1, row)
+
+    key, k1, k2 = jax.random.split(key, 3)
+    a = synth(k1)
+    b = edit(a, k2)
+    nv = np.full(1, seg, dtype=np.int32)
+    jax.block_until_ready([a, b])
+
+    t0 = time.time()
+    (ra,), (rb,) = pipeline.manifest_segments([(a, nv), (b, nv)],
+                                              strict_overflow=True)
+    dt = time.time() - t0
+    dig_a = {bytes(d) for d in ra[1]}
+    dup = sum(1 for d in rb[1] if bytes(d) in dig_a)
+    ratio = dup / max(len(rb[0]), 1)
+    mibs = 2 * seg_mib / dt
+
+    # parity + identical dedup ratio on an 8 MiB sub-pair
+    sub = 8 << 20
+    a8 = bytes(np.asarray(a[0, _HALO:_HALO + sub]))
+    b8 = bytes(np.asarray(b[0, _HALO:_HALO + sub]))
+    ca, da = _oracle(a8, params)
+    cb, db = _oracle(b8, params)
+    sa = set(da)
+    oracle_dup = sum(1 for d in db if d in sa)
+    dev_sub = []
+    for blob in (a8, b8):
+        ext = np.concatenate([np.zeros(_HALO, dtype=np.uint8),
+                              np.frombuffer(blob, dtype=np.uint8)])
+        (res,), = pipeline.manifest_resident_batch(
+            jnp.asarray(ext.reshape(1, -1)),
+            np.full(1, sub, dtype=np.int32))
+        _check(res, blob, params, "#3")
+        dev_sub.append(res)
+    dev_sa = {bytes(d) for d in dev_sub[0][1]}
+    dev_dup = sum(1 for d in dev_sub[1][1] if bytes(d) in dev_sa)
+    if dev_dup != oracle_dup:
+        raise RuntimeError("config #3: dedup-ratio divergence on sub-pair")
+    log(f"config#3 incremental: 2x{seg_mib} MiB in {dt:.2f}s = "
+        f"{mibs:.1f} MiB/s, dedup ratio {ratio:.3f} "
+        f"(oracle sub-pair dup {oracle_dup}/{len(cb)})")
+    return {"mib_s": round(mibs, 2), "dedup_ratio": round(ratio, 4)}
+
+
+def config4_large_stream(log: Callable) -> Dict:
+    """Large contiguous stream at 64 KiB average chunks — config #4."""
+    seg_mib = int(os.environ.get("BENCH_C4_MIB", "256"))
+    params = CDCParams.from_desired(64 << 10)
+    pipeline = DevicePipeline(params, l_bucket=256)
+    seg = seg_mib << 20
+    row = _HALO + seg
+
+    @jax.jit
+    def synth(key):
+        s = jax.random.randint(key, (seg,), 0, 256, dtype=jnp.uint8)
+        return jnp.concatenate([jnp.zeros(_HALO, dtype=jnp.uint8), s]
+                               ).reshape(1, row)
+
+    nv = np.full(1, seg, dtype=np.int32)
+    key = jax.random.PRNGKey(41)
+    key, kw, k1 = jax.random.split(key, 3)
+    pipeline.manifest_resident_batch(synth(kw), nv, strict_overflow=True)
+
+    buf = synth(k1)
+    jax.block_until_ready(buf)
+    t0 = time.time()
+    (chunks, digests), = pipeline.manifest_resident_batch(
+        buf, nv, strict_overflow=True)
+    dt = time.time() - t0
+    mibs = seg_mib / dt
+
+    sub = 8 << 20
+    data = bytes(np.asarray(buf[0, _HALO:_HALO + sub]))
+    ext = np.concatenate([np.zeros(_HALO, dtype=np.uint8),
+                          np.frombuffer(data, dtype=np.uint8)])
+    (dev_sub,), = pipeline.manifest_resident_batch(
+        jnp.asarray(ext.reshape(1, -1)), np.full(1, sub, dtype=np.int32))
+    _check(dev_sub, data, params, "#4")
+    log(f"config#4 large-stream(64KiB): {seg_mib} MiB in {dt:.2f}s = "
+        f"{mibs:.1f} MiB/s ({len(chunks)} chunks)")
+    return {"mib_s": round(mibs, 2), "chunks": len(chunks)}
+
+
+def config5_cross_peer(log: Callable) -> Dict:
+    """Cross-peer global dedup on the sharded HBM index — config #5."""
+    from jax.sharding import Mesh
+
+    from backuwup_tpu.ops.dedup_index import (ShardedDedupIndex,
+                                              hashes_to_queries)
+
+    n_hashes = int(os.environ.get("BENCH_C5_HASHES", "200000"))
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    rng = np.random.default_rng(51)
+    # 4 peers, ~50% of each corpus shared with a common pool
+    shared = [rng.bytes(32) for _ in range(n_hashes // 8)]
+    peers = []
+    for p in range(4):
+        own = [rng.bytes(32) for _ in range(n_hashes // 8)]
+        picks = rng.choice(len(shared), n_hashes // 8, replace=False)
+        peers.append(own + [shared[i] for i in picks])
+
+    index = ShardedDedupIndex.create(mesh, capacity=1 << 18)
+    host_seen = set()
+    host_flags = []
+    t0 = time.time()
+    dev_flags = []
+    for corpus in peers:
+        q = hashes_to_queries(corpus)
+        found = index.insert(q, np.ones(len(corpus), dtype=np.uint32))
+        dev_flags.extend(bool(f) for f in found)
+    dt = time.time() - t0
+    for corpus in peers:
+        for h in corpus:
+            host_flags.append(h in host_seen)
+            host_seen.add(h)
+    if dev_flags != host_flags:
+        raise RuntimeError("config #5: device/host global dedup mismatch")
+    total = sum(len(c) for c in peers)
+    rate = total / dt
+    ratio = sum(dev_flags) / total
+    log(f"config#5 cross-peer: {total} hashes over {len(mesh.devices)} "
+        f"device(s) in {dt:.2f}s = {rate:,.0f} hashes/s, global dup "
+        f"ratio {ratio:.3f}")
+    return {"hashes_s": round(rate), "dup_ratio": round(ratio, 4)}
+
+
+def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
+            log: Callable) -> Dict:
+    out = {}
+    for name, fn in (
+            ("2_small_files", lambda: config2_small_files(pipeline, params,
+                                                          log)),
+            ("3_incremental", lambda: config3_incremental(pipeline, params,
+                                                          log)),
+            ("4_large_stream_64k", lambda: config4_large_stream(log)),
+            ("5_cross_peer_dedup", lambda: config5_cross_peer(log))):
+        try:
+            out[name] = fn()
+            if "mib_s" in out[name]:
+                out[name]["vs_baseline"] = round(
+                    out[name]["mib_s"] / cpu_mibs, 2)
+        except Exception as e:  # a config failure must not kill the JSON
+            log(f"config {name} FAILED: {e}")
+            out[name] = {"error": str(e)[:200]}
+    return out

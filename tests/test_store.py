@@ -84,3 +84,32 @@ def test_persistence_across_reopen(tmp_path):
     assert s2.get_root_secret() == b"\x03" * 32
     assert s2.get_peer(b"\x04" * 32).bytes_negotiated == 777
     s2.close()
+
+
+def test_tracing_spans_and_report():
+    """Host tracing subsystem (SURVEY §5.1: the build adds what the
+    reference lacks)."""
+    from backuwup_tpu.utils import tracing
+
+    tracing.reset()
+    tracing.enable(True)
+    try:
+        with tracing.span("unit.test"):
+            pass
+
+        @tracing.traced("unit.decorated")
+        def f():
+            return 41
+
+        assert f() == 41
+        rep = tracing.report()
+        assert rep["unit.test"][0] == 1
+        assert rep["unit.decorated"][0] == 1
+        assert "unit.test" in tracing.format_report()
+    finally:
+        tracing.enable(False)
+        tracing.reset()
+    # disabled: no recording
+    with tracing.span("unit.off"):
+        pass
+    assert "unit.off" not in tracing.report()
