@@ -194,12 +194,15 @@ class DirPacker:
         """Chunk one huge file through the backend's streaming manifest;
         blobs pack as chunks finalize, so memory stays ~one segment.
 
-        The file is mmapped and fed as zero-copy memoryview windows
-        (dir_packer.rs:252's memmap2 analog) — bytes are only copied when
-        they stage into a device buffer or a packfile record.  The same
-        documented race as the reference applies: a file mutating
-        mid-chunk produces a wrong (detectably inconsistent) backup of
-        that file, never a crash.
+        The file is mmapped and fed as memoryview windows
+        (dir_packer.rs:252's memmap2 analog), so the packer never holds a
+        second buffered copy of the file; the backend still assembles one
+        per-segment buffer when it splices the carry onto each window.
+        The same documented race as the reference applies: a file
+        mutating mid-chunk produces a wrong (detectably inconsistent)
+        backup of that file, never a crash — mmap failures (e.g. the
+        file was truncated to empty after the stat) fall back to plain
+        reads.
         """
         import mmap as _mmap
 
@@ -209,30 +212,38 @@ class DirPacker:
             self.stats.chunks += 1
             self.stats.bytes_read += ref.length
             children.append(ref.hash)
-            self._add_blob(ref.hash, BlobKind.FILE_CHUNK, bytes(data))
+            self._add_blob(ref.hash, BlobKind.FILE_CHUNK, data)
 
         with open(path, "rb") as f:
-            size = st.st_size
-            if size > 0:
-                with _mmap.mmap(f.fileno(), 0,
-                                access=_mmap.ACCESS_READ) as mm:
-                    view = memoryview(mm)
-                    pos = 0
-
-                    def read(n: int):
-                        nonlocal pos
-                        out = view[pos:pos + n]
-                        pos += len(out)
-                        return out
-
-                    try:
-                        self.backend.manifest_stream(
-                            read, segment_bytes=self.batch_bytes, emit=emit)
-                    finally:
-                        view.release()
-            else:
+            try:
+                mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+            except (OSError, ValueError):
+                mm = None  # empty/truncated/unmappable: plain reads
+            if mm is None:
                 self.backend.manifest_stream(
                     f.read, segment_bytes=self.batch_bytes, emit=emit)
+            else:
+                view = memoryview(mm)
+                pos = 0
+
+                def read(n: int):
+                    nonlocal pos
+                    out = view[pos:pos + n]
+                    pos += len(out)
+                    return out
+
+                try:
+                    self.backend.manifest_stream(
+                        read, segment_bytes=self.batch_bytes, emit=emit)
+                finally:
+                    view.release()
+                    try:
+                        mm.close()
+                    except BufferError:
+                        # an in-flight exception's traceback still holds
+                        # window slices; closing would mask the real
+                        # error — let GC drop the mapping instead
+                        pass
         self.stats.files += 1
         self.progress(file=str(path), bytes=st.st_size)
         return self._tree_with_split(
