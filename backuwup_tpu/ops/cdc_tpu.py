@@ -337,47 +337,63 @@ def scan_select_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
     # nearly every candidate lands in its own 32-bit word on real data
     w_cap = max(512, min(l_cap, P // 32 if P >= 32 else 1))
 
-    def compact(cand, cap):
-        """Fixed-capacity candidate positions via TWO-LEVEL compaction.
+    def compact_both(cand_l, cand_s):
+        """Fixed-capacity (pos_l, is_s-derived pos_s) via TWO-LEVEL
+        compaction, paying the expensive pass only once.
 
         A direct ``jnp.nonzero`` over the full position axis costs seconds
         on a 128 MiB segment (measured: the cumsum+scatter over 1.3e8
         lanes dominates the whole pipeline); packing candidate bits 32:1
         into u32 words first makes the expensive nonzero 32x smaller, and
-        the second-level expansion works on ``w_cap*32`` lanes only.
+        the second-level expansion works on ``w_cap*32`` lanes only.  The
+        strict mask's bits ride along through the SAME compaction (its
+        candidates are a subset of the loose ones), so only one
+        word-level nonzero and zero full-axis reductions are needed.
         """
-        rem = (-cand.shape[0]) % 32
+        rem = (-cand_l.shape[0]) % 32
         if rem:
-            cand = jnp.concatenate(
-                [cand, jnp.zeros(rem, dtype=cand.dtype)])
-        words = _pack_bits(cand)
-        nzw = words != 0
-        (widx,) = jnp.nonzero(nzw, size=w_cap, fill_value=words.shape[0])
-        wsafe = jnp.clip(widx, 0, words.shape[0] - 1)
-        bits = words[wsafe]  # (w_cap,) u32, junk where widx overflowed
-        bits = jnp.where(widx < words.shape[0], bits, jnp.uint32(0))
+            pad = jnp.zeros(rem, dtype=cand_l.dtype)
+            cand_l = jnp.concatenate([cand_l, pad])
+            cand_s = jnp.concatenate([cand_s, pad])
+        words_l = _pack_bits(cand_l)
+        words_s = _pack_bits(cand_s)
+        nzw = words_l != 0
+        (widx,) = jnp.nonzero(nzw, size=w_cap, fill_value=words_l.shape[0])
+        wsafe = jnp.clip(widx, 0, words_l.shape[0] - 1)
+        in_range = widx < words_l.shape[0]
+        bits_l = jnp.where(in_range, words_l[wsafe], jnp.uint32(0))
+        bits_s = jnp.where(in_range, words_s[wsafe], jnp.uint32(0))
         lane = jnp.arange(32, dtype=jnp.int32)[None, :]
-        hasbit = ((bits[:, None] >> lane.astype(jnp.uint32)) & 1) == 1
+        has_l = ((bits_l[:, None] >> lane.astype(jnp.uint32)) & 1) == 1
+        has_s = ((bits_s[:, None] >> lane.astype(jnp.uint32)) & 1) == 1
         posmat = widx[:, None].astype(jnp.int32) * 32 + lane
-        flat_has = hasbit.reshape(-1)
-        flat_pos = jnp.where(flat_has, posmat.reshape(-1), P)
-        (sel,) = jnp.nonzero(flat_has, size=cap, fill_value=flat_pos.shape[0])
-        pos = flat_pos[jnp.clip(sel, 0, flat_pos.shape[0] - 1)]
-        pos = jnp.where(sel < flat_pos.shape[0], pos, P)
-        word_overflow = jnp.sum(nzw.astype(jnp.int32)) > w_cap
-        return pos.astype(jnp.int32), word_overflow
+        flat_l = has_l.reshape(-1)
+        flat_s = has_s.reshape(-1)
+        # no masking needed: sel below only gathers flat_l-true lanes, and
+        # out-of-range gathers are overwritten with P by sel_ok
+        flat_pos = posmat.reshape(-1)
+        flat_n = flat_pos.shape[0]
+        (sel,) = jnp.nonzero(flat_l, size=l_cap, fill_value=flat_n)
+        sel_ok = sel < flat_n
+        sel_safe = jnp.clip(sel, 0, flat_n - 1)
+        pos_l = jnp.where(sel_ok, flat_pos[sel_safe], P).astype(jnp.int32)
+        is_s = sel_ok & flat_s[sel_safe]
+        (ssel,) = jnp.nonzero(is_s, size=s_cap, fill_value=l_cap)
+        pos_s = jnp.where(ssel < l_cap,
+                          pos_l[jnp.clip(ssel, 0, l_cap - 1)],
+                          jnp.int32(P))
+        overflow = ((jnp.sum(nzw.astype(jnp.int32)) > w_cap)
+                    | (jnp.sum(flat_l.astype(jnp.int32)) > l_cap)
+                    | (jnp.sum(is_s.astype(jnp.int32)) > s_cap))
+        return pos_l, pos_s, overflow
 
     def one(ext, n):
         h = _hash_ext_fast(ext)
         valid = jnp.arange(P, dtype=jnp.int32) < n
         cand_l = ((h & ml) == 0) & valid
         cand_s = cand_l & ((h & ms) == 0)
-        n_l = jnp.sum(cand_l.astype(jnp.int32))
-        n_s = jnp.sum(cand_s.astype(jnp.int32))
-        pos_l, ovf_l = compact(cand_l, l_cap)
-        pos_s, ovf_s = compact(cand_s, s_cap)
-        overflow = ((n_l > l_cap) | (n_s > s_cap)
-                    | ovf_l | ovf_s).astype(jnp.int32)
+        pos_l, pos_s, ovf = compact_both(cand_l, cand_s)
+        overflow = ovf.astype(jnp.int32)
 
         def cond(st):
             s, k, _ = st
