@@ -218,7 +218,15 @@ class DevicePipeline:
                     buf_d[r, _HALO:_HALO + int(nv[r])]))
                 per_row.append(chunk_stream_cpu(row_bytes, self.params))
             else:
-                per_row.append(cuts_to_chunks(packed[r, 2:2 + n_cuts]))
+                # vectorized cuts -> (offset, length) pairs: the python
+                # per-chunk loop dominated many-small-file batches
+                ends = packed[r, 2:2 + n_cuts].astype(np.int64)
+                offs = np.empty(n_cuts, dtype=np.int64)
+                if n_cuts:
+                    offs[0] = 0
+                    np.add(ends[:-1], 1, out=offs[1:])
+                lens = ends - offs + 1
+                per_row.append(list(zip(offs.tolist(), lens.tolist())))
         return per_row
 
     # --- gather + digest (device) -----------------------------------------
