@@ -39,7 +39,6 @@ from .. import defaults
 from ..utils import tracing
 from .blake3_tpu import blake3_many_tpu, digest_padded
 from .cdc_cpu import chunk_stream as chunk_stream_cpu
-from .cdc_cpu import cuts_to_chunks, select_cuts
 from .cdc_tpu import (
     _HALO,
     TpuCdcScanner,
