@@ -73,15 +73,24 @@ def _async_to_host(arr) -> None:
 
 def _row_tiles(count: int, cap: int = 128) -> List[int]:
     """Decompose a chunk count into digest tile heights from
-    {128, 32, 8} clamped to ``cap`` (the pipeline's ``b_bucket``).
+    {512, 128, 32, 8} clamped to ``cap`` (the pipeline's ``b_bucket``).
 
     Big tiles amortize the per-op overhead of the unrolled BLAKE3 program
     (small-lane dispatches are latency-bound); the closed set keeps the
-    compiled-program universe finite.  Padding waste is bounded: <=64 rows
-    once, <=16 rows once, <=7 rows once.
+    compiled-program universe finite.  Padding waste is bounded: at most
+    one partially-filled tile per size class.  The 512 tier only engages
+    when the pipeline raises ``b_bucket`` (small-chunk configs whose
+    (B=128, L<=256) tiles are tiny-lane and dispatch-bound).
     """
     out: List[int] = []
     rem = count
+    if cap >= 512:
+        while rem >= 512:
+            out.append(512)
+            rem -= 512
+        if rem >= 256:
+            out.append(512)
+            rem = 0
     if cap >= 128:
         while rem >= 128:
             out.append(128)

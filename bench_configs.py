@@ -199,7 +199,9 @@ def config4_large_stream(log: Callable) -> Dict:
     """Large contiguous stream at 64 KiB average chunks — config #4."""
     seg_mib = int(os.environ.get("BENCH_C4_MIB", "256"))
     params = CDCParams.from_desired(64 << 10)
-    pipeline = DevicePipeline(params, l_bucket=256)
+    # small chunks -> small (L<=64) digest tiles: raise the row tier so
+    # dispatches carry enough lanes to amortize the BLAKE3 program
+    pipeline = DevicePipeline(params, l_bucket=256, b_bucket=512)
     seg = seg_mib << 20
     row = _HALO + seg
 
