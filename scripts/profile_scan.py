@@ -156,9 +156,6 @@ def main():
     for label, fn in [
         ("hash ladder only", hash_only),
         ("hash + cand counts", hash_cand),
-        ("hash + cand + 2x nonzero", hash_cand_nonzero),
-        ("full select (searchsorted while_loop)", full_searchsorted),
-        ("full select (sum lower_bound while_loop)", full_sumlb),
         ("production scan_select_batch", lambda b: scan_fn(b, nv_d)),
     ]:
         key, keys = keysplit(key, 3)
