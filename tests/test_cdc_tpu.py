@@ -88,3 +88,54 @@ def test_sharded_overflow_falls_back_to_oracle():
     data = _data(300_000, seed=13)
     got = chunk_stream_sharded(data, mesh, dense, k_cap=512)
     assert got == cdc_cpu.chunk_stream(data, dense)
+
+
+def test_scan_select_forced_cut_fallback_and_parallel_paths(rng):
+    """The pointer-doubling selection and its sequential fallback must both
+    be bit-identical to the oracle: zero runs force non-candidate cuts
+    (fallback), random data stays on the parallel path, and mixtures cross
+    between them mid-stream."""
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from backuwup_tpu.ops import cdc_cpu
+    from backuwup_tpu.ops.cdc_tpu import _HALO, scan_select_batch
+    from backuwup_tpu.ops.gear import CDCParams
+    from backuwup_tpu.ops.pipeline import DevicePipeline
+
+    params = CDCParams.from_desired(1024)
+    pipe = DevicePipeline(params, l_bucket=4)
+    cases = [
+        rng.randbytes(50_000),                      # parallel path
+        b"\x00" * 40_000,                           # all forced (fallback)
+        rng.randbytes(20_000) + b"\x00" * 20_000 + rng.randbytes(20_000),
+        b"\x00" * 20_000 + rng.randbytes(30_000),   # forced then candidates
+        rng.randbytes(1),                           # single byte
+        rng.randbytes(params.min_size),             # exactly min
+    ]
+    P = 65536
+    for data in cases:
+        n = len(data)
+        s_cap, l_cap, cut_cap = pipe._caps(P)
+        buf = np.zeros((1, _HALO + P), dtype=np.uint8)
+        buf[0, _HALO:_HALO + n] = np.frombuffer(data, dtype=np.uint8)
+        fn = functools.partial(
+            scan_select_batch, min_size=params.min_size,
+            desired_size=params.desired_size, max_size=params.max_size,
+            mask_s=params.mask_s, mask_l=params.mask_l,
+            s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap)
+        packed = np.asarray(fn(jnp.asarray(buf),
+                               jnp.asarray(np.full(1, n, dtype=np.int32))))
+        assert packed[0, 0] == 0, "unexpected overflow"
+        n_cuts = int(packed[0, 1])
+        ends = packed[0, 2:2 + n_cuts].tolist()
+        ref = cdc_cpu.select_cuts(*_oracle_candidates(data, params),
+                                  n, params).tolist()
+        assert ends == ref, (n, len(ref))
+
+
+def _oracle_candidates(data, params):
+    from backuwup_tpu.ops import cdc_cpu
+    return cdc_cpu.candidate_positions(data, params)
