@@ -165,3 +165,28 @@ def test_hard_cap_enforced_before_write(writer_env, nprng):
     # a single blob that cannot fit any sendable packfile is refused
     with pytest.raises(Exception):
         w.add_blob(_blob(nprng.integers(0, 256, 9 << 20, dtype="u1").tobytes()))
+
+
+def test_tampered_packfile_is_rejected(writer_env, nprng):
+    """Flipping ciphertext bits anywhere in a packfile must surface as a
+    loud decryption failure, never as silently wrong plaintext (AES-GCM
+    authenticates both the header and every blob record)."""
+    w, written, tmp = writer_env
+    data = nprng.integers(0, 256, 50_000, dtype="u1").tobytes()
+    blob = _blob(data)
+    w.add_blob(blob)
+    w.flush()
+    (pid, path, hashes, size) = written[0]
+    raw = bytearray(path.read_bytes())
+    reader = PackfileReader(KEYS, tmp / "pack")
+    assert reader.get_blob(pid, blob.hash).data == data
+
+    for flip_at in (12, len(raw) // 2, len(raw) - 3):
+        tampered = bytearray(raw)
+        tampered[flip_at] ^= 0x01
+        path.write_bytes(bytes(tampered))
+        with pytest.raises(Exception):
+            PackfileReader(KEYS, tmp / "pack").get_blob(pid, blob.hash)
+    path.write_bytes(bytes(raw))  # restore: intact file reads again
+    assert PackfileReader(KEYS, tmp / "pack").get_blob(
+        pid, blob.hash).data == data

@@ -157,3 +157,52 @@ def test_reregistration_after_phrase_recovery(tmp_path, loop):
         await server.stop()
 
     loop.run_until_complete(asyncio.wait_for(run(), 60))
+
+
+def test_full_backup_cycle_over_tls(tmp_path, tls_files, loop, monkeypatch):
+    """The complete two-client backup->match->transfer flow with the
+    control plane on https/wss end to end (data plane stays peer WS, as
+    in the reference)."""
+    import random
+
+    from backuwup_tpu.app import ClientApp
+    from backuwup_tpu.ops.backend import CpuBackend
+    from backuwup_tpu.ops.gear import CDCParams
+
+    cert_file, key_file = tls_files
+    monkeypatch.setenv("TLS_CA_FILE", str(cert_file))
+    monkeypatch.setenv("USE_TLS", "1")
+    rng = random.Random(31)
+    for name in ("a", "b"):
+        d = tmp_path / f"{name}_src"
+        d.mkdir()
+        (d / "f.bin").write_bytes(rng.randbytes(120_000))
+
+    async def run():
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert_file, key_file)
+        server = CoordinationServer(db_path=str(tmp_path / "server.db"))
+        port = await server.start(ssl_context=ctx)
+
+        def make_app(name):
+            app = ClientApp(config_dir=tmp_path / name / "cfg",
+                            data_dir=tmp_path / name / "data",
+                            server_addr=f"127.0.0.1:{port}",
+                            backend=CpuBackend(CDCParams.from_desired(4096)))
+            app.store.set_backup_path(str(tmp_path / f"{name}_src"))
+            return app
+
+        a, b = make_app("a"), make_app("b")
+        await a.start()
+        await b.start()
+        snap_a, snap_b = await asyncio.wait_for(
+            asyncio.gather(a.backup(), b.backup()), 120)
+        assert len(snap_a) == 32 and len(snap_b) == 32
+        assert server.db.get_latest_client_snapshot(a.client_id) == snap_a
+        await a.stop()
+        await b.stop()
+        await server.stop()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 180))
