@@ -181,7 +181,9 @@ def test_tampered_packfile_is_rejected(writer_env, nprng):
     reader = PackfileReader(KEYS, tmp / "pack")
     assert reader.get_blob(pid, blob.hash).data == data
 
-    for flip_at in (12, len(raw) // 2, len(raw) - 3):
+    # offsets cover the unauthenticated length prefix (0, 5), the header
+    # ciphertext (12), blob ciphertext (mid), and the final GCM tag
+    for flip_at in (0, 5, 12, len(raw) // 2, len(raw) - 3):
         tampered = bytearray(raw)
         tampered[flip_at] ^= 0x01
         path.write_bytes(bytes(tampered))
