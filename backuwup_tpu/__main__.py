@@ -18,6 +18,19 @@ from pathlib import Path
 from typing import Optional
 
 
+def _addr_host(addr: str) -> str:
+    """Host part of a ``host:port`` address, handling bracketed IPv6
+    literals like ``[::1]:8080`` and bare ``::1``."""
+    from urllib.parse import urlsplit
+    try:
+        host = urlsplit(f"//{addr}").hostname
+        if host:
+            return host
+    except ValueError:
+        pass
+    return addr  # bare IPv6 like ::1, or something urlsplit rejects
+
+
 def _install_excepthook(messenger) -> None:
     """Panic hook (client/src/main.rs:53-61): report to the UI channel,
     then exit nonzero."""
@@ -65,25 +78,27 @@ async def _run_client(args) -> int:
     # TLS is on by default (reference posture); a loopback server with no
     # explicit USE_TLS / CA configured is the local-testing case
     # (docs/src/client.md:22) — default it to plaintext so the
-    # out-of-the-box `server` + `client` pairing connects.
-    import os as _os
-    addr = args.server_addr or _os.environ.get("SERVER_ADDR",
-                                               "127.0.0.1:8080")
+    # out-of-the-box `server` + `client` pairing connects.  The decision
+    # is passed explicitly to ClientApp (never by mutating os.environ,
+    # which would leak into every ServerClient in the process).
+    addr = args.server_addr or os.environ.get("SERVER_ADDR",
+                                              "127.0.0.1:8080")
+    tls: Optional[bool] = None
     if args.no_tls:
-        _os.environ["USE_TLS"] = "0"
-    elif "USE_TLS" not in _os.environ \
-            and "TLS_CA_FILE" not in _os.environ \
-            and addr.split(":")[0] in ("127.0.0.1", "localhost", "::1"):
+        tls = False
+    elif "USE_TLS" not in os.environ and "TLS_CA_FILE" not in os.environ \
+            and _addr_host(addr) in ("127.0.0.1", "localhost", "::1"):
         print("note: loopback server and no TLS config; using plaintext "
               "(set USE_TLS=1 or TLS_CA_FILE to force TLS)", flush=True)
-        _os.environ["USE_TLS"] = "0"
+        tls = False
 
     app = ClientApp(
         config_dir=args.config_dir and Path(args.config_dir),
         data_dir=args.data_dir and Path(args.data_dir),
         server_addr=args.server_addr,
         messenger=messenger,
-        root_secret=root_secret)
+        root_secret=root_secret,
+        tls=tls)
     if app.fresh_identity and root_secret is None:
         ui_cli.print_recovery_phrase(app.keys.root_secret)
     if args.backup_path:

@@ -30,7 +30,8 @@ class ClientApp:
                  backend: Optional[ChunkerBackend] = None,
                  messenger: Optional[Messenger] = None,
                  dedup_mesh=None,
-                 root_secret: Optional[bytes] = None):
+                 root_secret: Optional[bytes] = None,
+                 tls: Optional[bool] = None):
         """``root_secret`` injects a recovered identity (the
         restore-from-phrase flow, ``identity.rs:46-69``): the secret is
         persisted and all keys re-derive deterministically, so a disaster
@@ -59,7 +60,8 @@ class ClientApp:
             self.fresh_identity = False
         if self.store.get_obfuscation_key() is None:
             self.store.set_obfuscation_key(os.urandom(4))
-        self.server = ServerClient(self.keys, self.store, addr=server_addr)
+        self.server = ServerClient(self.keys, self.store, addr=server_addr,
+                                   tls=tls)
         self.node = P2PNode(self.keys, self.store, self.server)
         self.node.on_transport_request = self._accept_peer_data
         self.node.on_restore_request = self._serve_restore

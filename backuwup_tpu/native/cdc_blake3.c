@@ -22,17 +22,17 @@
 static uint32_t GEAR[256];
 static int gear_ready = 0;
 
-/* SplitMix64 stream seeded with "backuwup" (ops/gear.py). */
+/* GEAR[b] = fmix32(GEAR_SEED32 + b), spec v2 (ops/gear.py). */
 static void gear_init(void) {
     if (gear_ready) return;
-    uint64_t state = 0x6261636B75777570ULL;
     for (int i = 0; i < 256; i++) {
-        state += 0x9E3779B97F4A7C15ULL;
-        uint64_t z = state;
-        z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
-        z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
-        z = z ^ (z >> 31);
-        GEAR[i] = (uint32_t)(z >> 32);
+        uint32_t h = 0x6261636BU + (uint32_t)i;
+        h ^= h >> 16;
+        h *= 0x85EBCA6BU;
+        h ^= h >> 13;
+        h *= 0xC2B2AE35U;
+        h ^= h >> 16;
+        GEAR[i] = h;
     }
     gear_ready = 1;
 }

@@ -116,6 +116,9 @@ class ServerClient:
         self.base = f"{scheme}://{self.addr}"
         self._http: Optional[aiohttp.ClientSession] = None
         self._ws_task: Optional[asyncio.Task] = None
+        # push-handler tasks (backup-matched / p2p rendezvous); cancelled
+        # on close so none outlive the event loop (teardown hygiene)
+        self._handler_tasks: set = set()
         self.on_backup_matched: Optional[Callable] = None
         self.on_incoming_p2p: Optional[Callable] = None
         self.on_finalize_p2p: Optional[Callable] = None
@@ -138,8 +141,19 @@ class ServerClient:
             except (asyncio.CancelledError, Exception):
                 pass
             self._ws_task = None
+        for t in list(self._handler_tasks):
+            t.cancel()
+        if self._handler_tasks:
+            await asyncio.gather(*self._handler_tasks,
+                                 return_exceptions=True)
+            self._handler_tasks.clear()
         if self._http is not None and not self._http.closed:
             await self._http.close()
+
+    def _spawn_handler(self, coro) -> None:
+        task = asyncio.create_task(coro)
+        self._handler_tasks.add(task)
+        task.add_done_callback(self._handler_tasks.discard)
 
     # --- raw RPC -----------------------------------------------------------
 
@@ -271,8 +285,8 @@ class ServerClient:
             return
         # each push handled in its own task (net_server/mod.rs:58-90)
         if isinstance(msg, wire.BackupMatched) and self.on_backup_matched:
-            asyncio.create_task(self.on_backup_matched(msg))
+            self._spawn_handler(self.on_backup_matched(msg))
         elif isinstance(msg, wire.IncomingP2PConnection) and self.on_incoming_p2p:
-            asyncio.create_task(self.on_incoming_p2p(msg))
+            self._spawn_handler(self.on_incoming_p2p(msg))
         elif isinstance(msg, wire.FinalizeP2PConnection) and self.on_finalize_p2p:
-            asyncio.create_task(self.on_finalize_p2p(msg))
+            self._spawn_handler(self.on_finalize_p2p(msg))

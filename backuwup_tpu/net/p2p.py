@@ -129,7 +129,13 @@ class Transport:
         except websockets.ConnectionClosed:
             pass
         finally:
-            await self._recv_queue.put(None)
+            # put_nowait (queue is unbounded): the await form would fail
+            # with "Event loop is closed" when the task is GC'd at
+            # interpreter/loop teardown
+            try:
+                self._recv_queue.put_nowait(None)
+            except RuntimeError:
+                pass
 
     async def send_data(self, data: bytes, file_info: wire.FileInfoKind,
                         file_id: bytes) -> None:
@@ -344,29 +350,32 @@ class P2PNode:
             await accepted.put((body.request_type, t, done))
             await done.wait()  # keep the ws handler alive while serving
 
-        # random high port (net_p2p/mod.rs:26-35)
+        # random high port (net_p2p/mod.rs:26-35); the outer try/finally
+        # guarantees the listener is closed even if this handler task is
+        # cancelled mid-await (client shutdown)
         server = await websockets.serve(
             handler, self.bind_host, 0,
             max_size=defaults.MAX_P2P_MESSAGE_SIZE)
-        port = server.sockets[0].getsockname()[1]
-        await self.server.p2p_connection_confirm(
-            source, f"{self.bind_host}:{port}")
         try:
-            request_type, transport, done = await asyncio.wait_for(
-                accepted.get(), 30)
-        except asyncio.TimeoutError:
-            server.close()
-            return
-        try:
-            if request_type == wire.RequestType.TRANSPORT:
-                if self.on_transport_request is not None:
-                    await self.on_transport_request(source, transport)
-            elif request_type == wire.RequestType.RESTORE_ALL:
-                if self.on_restore_request is not None:
-                    await self.on_restore_request(source, transport)
+            port = server.sockets[0].getsockname()[1]
+            await self.server.p2p_connection_confirm(
+                source, f"{self.bind_host}:{port}")
+            try:
+                request_type, transport, done = await asyncio.wait_for(
+                    accepted.get(), 30)
+            except asyncio.TimeoutError:
+                return
+            try:
+                if request_type == wire.RequestType.TRANSPORT:
+                    if self.on_transport_request is not None:
+                        await self.on_transport_request(source, transport)
+                elif request_type == wire.RequestType.RESTORE_ALL:
+                    if self.on_restore_request is not None:
+                        await self.on_restore_request(source, transport)
+            finally:
+                done.set()
+                await transport.close()
         finally:
-            done.set()
-            await transport.close()
             server.close()
 
     # --- restore serving (restore_send.rs) ---------------------------------

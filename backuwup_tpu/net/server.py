@@ -226,6 +226,13 @@ class StorageQueue:
                 # it).  A failed candidate push rolls the record back; the
                 # reference instead records after notify
                 # (backup_request.rs:95-139) and carries that window.
+                # Known residual window: a server CRASH between the save and
+                # the notify leaves a phantom record neither client knows
+                # about.  That is harmless on the send path (the peer simply
+                # never dials) and tolerated on restore: the phantom peer
+                # refuses the dial as an unknown peer, and the client
+                # proceeds anyway when the data from the remaining peers
+                # covers the snapshot (engine._restored_coverage_gap).
                 self.db.save_storage_negotiated(bytes(client_id), candidate,
                                                 match)
                 self.db.save_storage_negotiated(candidate, bytes(client_id),

@@ -46,34 +46,25 @@ from .gear import GEAR, GEAR_WINDOW, CDCParams
 
 _HALO = GEAR_WINDOW - 1  # 31 bytes of left context carry the full hash state
 
-# Gear table split into four 8-bit limbs, (256, 4) — bf16-exact operand.
-_GEAR_LIMBS = np.stack(
-    [(GEAR >> (8 * j)) & 0xFF for j in range(4)], axis=1).astype(np.float32)
-
-# Nibble-bilinear form: GEAR[16*hi + lo] = T8_j[hi, lo] per 8-bit limb.
-# Entries <= 255 and one-hots are exact in bf16 (TPU f32 matmuls drop to
-# bf16 under excess-precision, so 16-bit limbs are NOT safe), so
-# oh_hi @ T then a masked row-sum reconstructs the table value exactly
-# with 16-wide one-hots — ~16x less one-hot traffic than the 256-wide
-# form (PERF.md round-4 direction 1).
-_GEAR_T8 = [np.ascontiguousarray(
-    ((GEAR.reshape(16, 16) >> (8 * j)) & 0xFF).astype(np.float32))
-    for j in range(4)]
-
 
 def _gear_values(b: jnp.ndarray) -> jnp.ndarray:
-    """GEAR[b] for a u8 vector via the nibble-bilinear MXU form."""
-    bi = b.astype(jnp.int32)
-    iota = jnp.arange(16, dtype=jnp.int32)
-    oh_hi = ((bi >> 4)[:, None] == iota[None, :]).astype(jnp.bfloat16)
-    oh_lo = ((bi & 15)[:, None] == iota[None, :]).astype(jnp.float32)
-    g = None
-    for j, tab in enumerate(_GEAR_T8):
-        tmp = jnp.dot(oh_hi, jnp.asarray(tab, dtype=jnp.bfloat16),
-                      preferred_element_type=jnp.float32)
-        gj = jnp.sum(tmp * oh_lo, axis=1).astype(jnp.uint32)
-        g = gj if g is None else g | (gj << jnp.uint32(8 * j))
-    return g
+    """GEAR[b] computed per position: ``fmix32(GEAR_SEED32 + b)``.
+
+    Seven fused elementwise u32 VPU ops — no gather (serializes on TPU)
+    and no one-hot matmul (round 3's nibble-bilinear MXU form paid
+    ~16 bytes of one-hot HBM traffic per stream byte and was the
+    measured scan floor at ~215 ms/256 MiB; this form is pure
+    fuseable arithmetic).  Bit-identical to ``GEAR[b]`` by
+    construction (gear.make_gear_table evaluates the same formula).
+    """
+    from .gear import GEAR_SEED32
+    h = b.astype(jnp.uint32) + jnp.uint32(GEAR_SEED32)
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
 
 
 def _hash_ext(ext: jnp.ndarray, halo_len: jnp.ndarray) -> jnp.ndarray:

@@ -1,4 +1,16 @@
-"""Deterministic GEAR table + CDC parameter set (see CDC_SPEC.md)."""
+"""Deterministic GEAR table + CDC parameter set (see CDC_SPEC.md).
+
+The gear function is **computable, not just tabulated**: ``GEAR[b] =
+fmix32(GEAR_SEED32 + b)`` where ``fmix32`` is the murmur3 32-bit
+finalizer.  Hosts (CPU oracle, native C baseline) precompute the 256-entry
+table once; the TPU scan computes the formula per position on the VPU —
+7 fused elementwise u32 ops — because table gathers serialize on TPU and
+one-hot MXU lookups pay ~16-64 bytes of HBM traffic per stream byte
+(round-3's measured floor, PERF.md).  Spec v2; v1 was SplitMix64-seeded
+(changing the table re-chunks streams, so v1 and v2 snapshots do not
+dedup against each other — acceptable pre-release, recorded in
+CHANGES.md).
+"""
 
 from __future__ import annotations
 
@@ -8,27 +20,25 @@ import numpy as np
 
 from .. import defaults
 
-_M64 = (1 << 64) - 1
-GEAR_SEED = 0x6261636B75777570  # "backuwup"
+_M32 = 0xFFFFFFFF
+GEAR_SEED32 = 0x6261636B  # "back"
 GEAR_WINDOW = 32  # bytes of influence of the 32-bit rolling hash
 
 
-def _splitmix64_stream(seed: int, count: int):
-    out = []
-    state = seed
-    for _ in range(count):
-        state = (state + 0x9E3779B97F4A7C15) & _M64
-        z = state
-        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _M64
-        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _M64
-        z = z ^ (z >> 31)
-        out.append(z)
-    return out
+def fmix32(h: int) -> int:
+    """murmur3 finalizer: full-avalanche bijection on u32."""
+    h &= _M32
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h
 
 
 def make_gear_table() -> np.ndarray:
-    """256 x uint32, high halves of SplitMix64(GEAR_SEED) outputs."""
-    return np.array([z >> 32 for z in _splitmix64_stream(GEAR_SEED, 256)],
+    """256 x uint32: ``fmix32(GEAR_SEED32 + b)`` for b in 0..255."""
+    return np.array([fmix32(GEAR_SEED32 + b) for b in range(256)],
                     dtype=np.uint32)
 
 

@@ -6,9 +6,13 @@ the one-hot never leaves VMEM — each grid program stages 32 KiB of bytes,
 expands+contracts them against the 256x4 limb table on the MXU in 8 KiB
 sub-blocks, and writes only the 4-byte gear value per byte back to HBM.
 
-Kernels gate themselves on the runtime platform: on non-TPU backends the
-callers fall back to the pure-XLA paths (bit-identical by construction;
-asserted by tests/test_pallas.py on the TPU rig).
+STATUS: EXPERIMENTAL / not wired into the production pipeline.  The
+measured round-3 variants here lose to the XLA path (per-limb matvecs
+cost ~1M tiny MXU launches, ~315 ms/128 MiB vs ~110 ms for XLA's fused
+nibble-bilinear form — PERF.md "dead ends").  They are kept as working,
+parity-tested reference points for Mosaic layout experiments
+(tests/test_pallas.py runs them on the TPU rig only); the production
+scan path lives in cdc_tpu.py.
 """
 
 from __future__ import annotations

@@ -37,6 +37,33 @@ def fetch_full_tree(resolve: Callable[[bytes], Blob], head_hash: bytes) -> Tree:
     return tree
 
 
+def snapshot_coverage_gap(resolve: Callable[[bytes], Blob],
+                          has_blob: Callable[[bytes], bool],
+                          snapshot_hash: bytes) -> Optional[bytes]:
+    """Walk the snapshot's tree graph without writing anything; return the
+    first unresolvable blob hash, or ``None`` when every tree and file
+    chunk is present.  Lets a restore with failed peer streams proceed
+    anyway when the restored data already covers the snapshot (e.g. a
+    phantom negotiated peer that stores nothing — see the matcher's
+    crash-window note in net/server.py)."""
+    try:
+        root = fetch_full_tree(resolve, snapshot_hash)
+    except Exception:
+        return bytes(snapshot_hash)
+    queue = deque([root])
+    while queue:
+        tree = queue.popleft()
+        for child_hash in tree.children:
+            if tree.kind == TreeKind.DIR:
+                try:
+                    queue.append(fetch_full_tree(resolve, child_hash))
+                except Exception:
+                    return bytes(child_hash)
+            elif not has_blob(child_hash):
+                return bytes(child_hash)
+    return None
+
+
 class DirUnpacker:
     """``resolve`` maps a blob hash to a :class:`Blob` (index + reader)."""
 

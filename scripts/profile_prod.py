@@ -1,0 +1,53 @@
+"""Calibrated timings of the CURRENT production pipeline stages."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from backuwup_tpu.utils.jaxcache import enable_compilation_cache
+enable_compilation_cache()
+import jax, jax.numpy as jnp, numpy as np
+from backuwup_tpu.ops.cdc_tpu import _HALO
+from backuwup_tpu.ops.gear import CDCParams
+from backuwup_tpu.ops.pipeline import DevicePipeline
+
+SEG_MIB = int(os.environ.get("PROF_SEGMENT_MIB", "128"))
+REPS = 5
+N = SEG_MIB << 20
+row = _HALO + N
+params = CDCParams()
+pipe = DevicePipeline(params)
+nv = np.full(1, N, dtype=np.int32)
+
+@jax.jit
+def fresh(buf, i):
+    return buf.at[0, i].add(jnp.uint8(1))
+
+key = jax.random.PRNGKey(3)
+base = jax.random.randint(key, (1, row), 0, 256, dtype=jnp.uint8)
+jax.block_until_ready(base)
+
+def timeit(label, fn):
+    out = fn(fresh(base, jnp.int32(0)))  # warm
+    t0 = time.time()
+    for r in range(REPS):
+        out = fn(fresh(base, jnp.int32(r + 1)))
+    leaves = jax.tree_util.tree_leaves(out)
+    if leaves and hasattr(leaves[0], 'block_until_ready'):
+        np.asarray(leaves[0]).ravel()[:1]
+        jax.block_until_ready(out)
+    dt = (time.time() - t0) / REPS
+    print(f"{label:46s} {dt*1e3:9.1f} ms ({SEG_MIB/dt:8.1f} MiB/s)", flush=True)
+    return dt
+
+nop_dt = timeit("update+nop (calibration)",
+                lambda b: jnp.sum(b[0, :128].astype(jnp.uint32)))
+timeit("production scan_select dispatch+download",
+       lambda b: np.asarray(pipe.scan_select_dispatch(b, nv)))
+def full(b):
+    return pipe.manifest_resident_batch(b, nv, strict_overflow=True)
+out = full(fresh(base, jnp.int32(99)))
+t0 = time.time()
+for r in range(REPS):
+    out = full(fresh(base, jnp.int32(100 + r)))
+dt = (time.time() - t0) / REPS
+print(f"{'production manifest_resident_batch (e2e)':46s} {dt*1e3:9.1f} ms "
+      f"({SEG_MIB/dt:8.1f} MiB/s)", flush=True)
+print(f"(calibration to subtract: {nop_dt*1e3:.1f} ms)", flush=True)

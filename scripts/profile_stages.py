@@ -1,0 +1,331 @@
+"""Stage-isolated scan timings.
+
+Methodology for the axon relay (see memory/PERF.md): the relay caches
+identical dispatches and block_until_ready does not reliably fence, so
+(a) every rep gets a *fresh* input via a cheap jitted update of one
+resident buffer, and (b) each stage is reported as [update+stage] -
+[update+nop] so the copy and dispatch overheads cancel.
+
+Round-4 finding this measures: u8 elementwise throughput is ~6 GB/s on
+this chip (1D T(1024) layout, one byte per 32-bit lane), so the scan
+must run on u32 *words* (4 bytes/lane).  u8->u32 bitcast must go through
+(..., 128, 4) shapes — a (M, 4) trailing axis pads 32x in HBM.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from backuwup_tpu.utils.jaxcache import enable_compilation_cache
+
+enable_compilation_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from backuwup_tpu.ops.cdc_tpu import _HALO, _gear_values, _pack_bits
+from backuwup_tpu.ops.gear import CDCParams, GEAR_SEED32
+
+SEG_MIB = int(os.environ.get("PROF_SEGMENT_MIB", "128"))
+REPS = int(os.environ.get("PROF_REPS", "5"))
+N = SEG_MIB << 20
+NW = N // 4
+params = CDCParams()
+ms, ml = jnp.uint32(params.mask_s), jnp.uint32(params.mask_l)
+
+
+@jax.jit
+def fresh_u8(buf, i):
+    return buf.at[i].add(jnp.uint8(1))
+
+
+@jax.jit
+def fresh_u32(buf, i):
+    return buf.at[i].add(jnp.uint32(1))
+
+
+def run(fn, base, fresh):
+    b = fresh(base, jnp.int32(0))
+    out = fn(b)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    t0 = time.time()
+    for r in range(REPS):
+        b = fresh(base, jnp.int32(r + 1))
+        out = fn(b)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    jax.block_until_ready(out)
+    return (time.time() - t0) / REPS
+
+
+def report(label, fn, base, fresh, nop_dt):
+    dt = run(fn, base, fresh) - nop_dt
+    mibs = SEG_MIB / dt if dt > 1e-9 else float("inf")
+    print(f"{label:52s} {dt*1e3:9.1f} ms ({mibs:8.1f} MiB/s)", flush=True)
+
+
+@jax.jit
+def nop_u8(ext):
+    return jnp.sum(ext[:1024].astype(jnp.uint32))
+
+
+@jax.jit
+def nop_u32(w):
+    return jnp.sum(w[:1024])
+
+
+@jax.jit
+def u8_sum(ext):
+    return jnp.sum(ext.astype(jnp.uint32))
+
+
+@jax.jit
+def u32_sum(w):
+    return jnp.sum(w)
+
+
+@jax.jit
+def u8_to_words_sum(ext):
+    w = jax.lax.bitcast_convert_type(
+        ext.reshape(-1, 128, 4), jnp.uint32).reshape(-1)
+    return jnp.sum(w)
+
+
+def _gear_fmix(b32):
+    h = b32 + jnp.uint32(GEAR_SEED32)
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def _planes(w):
+    """u32 words -> four u32 gear-value planes (plane j: positions 4m+j)."""
+    return [_gear_fmix((w >> jnp.uint32(8 * j)) & jnp.uint32(0xFF))
+            for j in range(4)]
+
+
+def _wshift(a, q):
+    if q == 0:
+        return a
+    return jnp.concatenate([jnp.zeros(q, dtype=a.dtype), a[:-q]])
+
+
+def _ladder_planes(planes):
+    for t in range(5):
+        s = 1 << t
+        new = []
+        for p in range(4):
+            src_p = (p - s) % 4
+            q = (s - p + src_p) // 4
+            new.append(planes[p] + (_wshift(planes[src_p], q)
+                                    << jnp.uint32(s)))
+        planes = new
+    return planes
+
+
+@jax.jit
+def plane_gear_sum(w):
+    return sum(jnp.sum(p) for p in _planes(w))
+
+
+@jax.jit
+def plane_ladder_sum(w):
+    return sum(jnp.sum(p) for p in _ladder_planes(_planes(w)))
+
+
+@jax.jit
+def plane_words_sum(w):
+    pl = _ladder_planes(_planes(w))
+    acc_l = None
+    acc_s = None
+    for p in range(4):
+        cl = ((pl[p] & ml) == 0)
+        cs = cl & ((pl[p] & ms) == 0)
+        shifts = (jnp.arange(8, dtype=jnp.uint32) * 4 + p)[None, :]
+        wl = jnp.sum(cl.reshape(-1, 8).astype(jnp.uint32) << shifts, axis=1,
+                     dtype=jnp.uint32)
+        ws = jnp.sum(cs.reshape(-1, 8).astype(jnp.uint32) << shifts, axis=1,
+                     dtype=jnp.uint32)
+        acc_l = wl if acc_l is None else acc_l | wl
+        acc_s = ws if acc_s is None else acc_s | ws
+    return jnp.sum(acc_l), jnp.sum(acc_s)
+
+
+@jax.jit
+def plane_words_nonzero(w):
+    """Full front end on words: gear, ladder, candidates, pack, two-level
+    compaction (the production scan's output structure)."""
+    pl = _ladder_planes(_planes(w))
+    acc_l = None
+    acc_s = None
+    for p in range(4):
+        cl = ((pl[p] & ml) == 0)
+        cs = cl & ((pl[p] & ms) == 0)
+        shifts = (jnp.arange(8, dtype=jnp.uint32) * 4 + p)[None, :]
+        wl = jnp.sum(cl.reshape(-1, 8).astype(jnp.uint32) << shifts, axis=1,
+                     dtype=jnp.uint32)
+        ws = jnp.sum(cs.reshape(-1, 8).astype(jnp.uint32) << shifts, axis=1,
+                     dtype=jnp.uint32)
+        acc_l = wl if acc_l is None else acc_l | wl
+        acc_s = ws if acc_s is None else acc_s | ws
+    nz = acc_l != 0
+    (widx,) = jnp.nonzero(nz, size=8192, fill_value=-1)
+    safe = jnp.clip(widx, 0, acc_l.shape[0] - 1)
+    return widx, acc_l[safe], acc_s[safe], jnp.sum(nz.astype(jnp.int32))
+
+
+print(f"devices: {jax.devices()}  segment={SEG_MIB} MiB  reps={REPS}",
+      flush=True)
+key = jax.random.PRNGKey(7)
+base_u8 = jax.random.randint(key, (N,), 0, 256, dtype=jnp.uint8)
+base_u32 = jax.lax.bitcast_convert_type(
+    base_u8.reshape(-1, 128, 4), jnp.uint32).reshape(-1)
+jax.block_until_ready((base_u8, base_u32))
+
+nop8 = run(nop_u8, base_u8, fresh_u8)
+print(f"{'u8 update+nop (calibration)':52s} {nop8*1e3:9.1f} ms", flush=True)
+nop32 = run(nop_u32, base_u32, fresh_u32)
+print(f"{'u32 update+nop (calibration)':52s} {nop32*1e3:9.1f} ms", flush=True)
+report("u8 sum", u8_sum, base_u8, fresh_u8, nop8)
+# NOTE: u8->u32 device bitcast at 128 MiB is uncompilable: XLA lowers it
+# as convert+combine with a (..., 4)-shaped u32 temp padded 32x -> OOM.
+# Words must be uploaded/synthesized as u32 from the start.
+report("u32 word sum", u32_sum, base_u32, fresh_u32, nop32)
+report("WORDS gear x4 planes + sum", plane_gear_sum, base_u32, fresh_u32,
+       nop32)
+report("WORDS gear + ladder + sum", plane_ladder_sum, base_u32, fresh_u32,
+       nop32)
+report("WORDS gear + ladder + packed words + sum", plane_words_sum,
+       base_u32, fresh_u32, nop32)
+report("WORDS full front end (with nonzero)", plane_words_nonzero,
+       base_u32, fresh_u32, nop32)
+
+
+# --- packing + compaction variants ---------------------------------------
+
+def _pack_planes_reshape(cls):
+    """Variant A: (M, 8) reshape + weighted sum per plane (current)."""
+    acc = None
+    for p, cl in enumerate(cls):
+        shifts = (jnp.arange(8, dtype=jnp.uint32) * 4 + p)[None, :]
+        w = jnp.sum(cl.reshape(-1, 8) << shifts, axis=1, dtype=jnp.uint32)
+        acc = w if acc is None else acc | w
+    return acc
+
+
+def _pack_planes_doubling(cls):
+    """Variant B: log-doubling pairwise combine via strided slices.
+    Bit mapping: plane p -> bits [8p..8p+7], position j within group in
+    bit-reversal-ish order fixed by the doubling; any fixed per-word
+    permutation is decodable."""
+    acc = None
+    for p, cl in enumerate(cls):
+        a = cl
+        sh = 1
+        for _ in range(3):  # 8 -> 1 entries
+            a = a[0::2] | (a[1::2] << jnp.uint32(sh))
+            sh *= 2
+        acc = (a << jnp.uint32(8 * p)) if acc is None else \
+            acc | (a << jnp.uint32(8 * p))
+    return acc
+
+
+@jax.jit
+def pack_reshape_sum(w):
+    pl = _ladder_planes(_planes(w))
+    cls = [((p & ml) == 0).astype(jnp.uint32) for p in pl]
+    return jnp.sum(_pack_planes_reshape(cls))
+
+
+@jax.jit
+def pack_doubling_sum(w):
+    pl = _ladder_planes(_planes(w))
+    cls = [((p & ml) == 0).astype(jnp.uint32) for p in pl]
+    return jnp.sum(_pack_planes_doubling(cls))
+
+
+@jax.jit
+def nonzero_only(w):
+    """Word-level nonzero cost on N/32 words (no gather)."""
+    words = (w[: NW // 8] * jnp.uint32(2654435761)) > jnp.uint32(0xFFFFF000)
+    (widx,) = jnp.nonzero(words, size=8192, fill_value=-1)
+    return widx
+
+
+@jax.jit
+def nonzero_gather(w):
+    words = w[: NW // 8] * jnp.uint32(2654435761)
+    nz = words > jnp.uint32(0xFFFFF000)
+    (widx,) = jnp.nonzero(nz, size=8192, fill_value=-1)
+    safe = jnp.clip(widx, 0, words.shape[0] - 1)
+    return widx, words[safe]
+
+
+@jax.jit
+def full_doubling_front(w):
+    """Doubling pack + 3-level compaction (OR-superwords before nonzero)."""
+    pl = _ladder_planes(_planes(w))
+    cls = [((p & ml) == 0).astype(jnp.uint32) for p in pl]
+    css = [(c & (((p & ms) == 0).astype(jnp.uint32))) for c, p in
+           zip(cls, pl)]
+    wl = _pack_planes_doubling(cls)
+    ws = _pack_planes_doubling(css)
+    sup = wl[0::4] | wl[1::4] | wl[2::4] | wl[3::4]
+    nz = sup != 0
+    (sidx,) = jnp.nonzero(nz, size=2048, fill_value=-1)
+    safe = jnp.clip(sidx, 0, sup.shape[0] - 1)
+    # expand each nonzero superword back to its 4 words
+    g = (safe[:, None] * 4 + jnp.arange(4, dtype=sidx.dtype)[None, :]
+         ).reshape(-1)
+    return sidx, wl[g], ws[g], jnp.sum(nz.astype(jnp.int32))
+
+
+report("pack variant A: (M,8) reshape", pack_reshape_sum, base_u32,
+       fresh_u32, nop32)
+report("pack variant B: strided doubling", pack_doubling_sum, base_u32,
+       fresh_u32, nop32)
+report("nonzero only (N/32 words)", nonzero_only, base_u32, fresh_u32,
+       nop32)
+report("nonzero + 8k gather", nonzero_gather, base_u32, fresh_u32, nop32)
+report("FULL: doubling pack + 3-level compact", full_doubling_front,
+       base_u32, fresh_u32, nop32)
+
+
+def _cand_u32(h, bits):
+    """Indicator((h & top-bits-mask) == 0) as pure u32 arithmetic — no
+    bool arrays (i1 lives in u8 lanes, the slow path)."""
+    return jnp.minimum(h >> jnp.uint32(32 - bits), jnp.uint32(1)) \
+        ^ jnp.uint32(1)
+
+
+@jax.jit
+def full_u32_front(w):
+    """Word-native front end with pure-u32 indicators end to end."""
+    pl = _ladder_planes(_planes(w))
+    acc_l = None
+    acc_s = None
+    for p in range(4):
+        cl = _cand_u32(pl[p], params.mask_l_bits)
+        cs = cl & _cand_u32(pl[p], params.mask_s_bits)
+        shifts = (jnp.arange(8, dtype=jnp.uint32) * 4 + p)[None, :]
+        wl = jnp.sum(cl.reshape(-1, 8) << shifts, axis=1, dtype=jnp.uint32)
+        ws = jnp.sum(cs.reshape(-1, 8) << shifts, axis=1, dtype=jnp.uint32)
+        acc_l = wl if acc_l is None else acc_l | wl
+        acc_s = ws if acc_s is None else acc_s | ws
+    nz = acc_l != 0
+    (widx,) = jnp.nonzero(nz, size=8192, fill_value=-1)
+    safe = jnp.clip(widx, 0, acc_l.shape[0] - 1)
+    return widx, acc_l[safe], acc_s[safe], jnp.sum(nz.astype(jnp.int32))
+
+
+report("FULL u32-indicator front end", full_u32_front, base_u32,
+       fresh_u32, nop32)
+report("FULL u32-indicator front end (rep2)", full_u32_front, base_u32,
+       fresh_u32, nop32)

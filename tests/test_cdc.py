@@ -15,8 +15,8 @@ def test_gear_table_properties():
     assert GEAR.shape == (256,) and GEAR.dtype == np.uint32
     assert len(set(GEAR.tolist())) == 256  # no collisions in the table
     # regression pin: table is deterministic data, not environment-dependent
-    assert GEAR[0] == np.uint32(0x131937B3), hex(int(GEAR[0]))
-    assert GEAR[1] == np.uint32(0x9E5463A0), hex(int(GEAR[1]))
+    assert GEAR[0] == np.uint32(0xD5237E27), hex(int(GEAR[0]))
+    assert GEAR[1] == np.uint32(0xAE4C672E), hex(int(GEAR[1]))
 
 
 def test_gear_hash_scalar_vs_vectorized(nprng):

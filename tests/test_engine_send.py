@@ -123,3 +123,57 @@ def test_index_send_skips_already_watermarked(engine):
 
     asyncio.new_event_loop().run_until_complete(run())
     assert transport.sent == [2, 3]
+
+class PackfileTransport:
+    """Records packfile sends in order."""
+
+    def __init__(self):
+        self.sent = []
+
+    async def send_data(self, data, kind, file_id):
+        assert kind == wire.FileInfoKind.PACKFILE
+        self.sent.append(bytes(file_id))
+
+    async def close(self):
+        pass
+
+
+def test_send_loop_skips_oversized_packfile_not_stops(engine, monkeypatch):
+    """ADVICE r3 (medium): a large packfile sorting FIRST in directory
+    order must not starve a smaller one that fits the peer — the loop
+    skips files that don't fit instead of breaking, otherwise the same
+    almost-full peer is re-dialed forever."""
+    from backuwup_tpu import defaults
+
+    monkeypatch.setattr(defaults, "PEER_OVERUSE_GRACE", 0)
+
+    pack_dir = engine._pack_dir()
+    big_id, small_id = b"\xaa" * 12, b"\xbb" * 12
+    (pack_dir / "aa").mkdir(parents=True)
+    (pack_dir / "bb").mkdir(parents=True)
+    (pack_dir / "aa" / big_id.hex()).write_bytes(b"B" * 10_000)
+    (pack_dir / "bb" / small_id.hex()).write_bytes(b"s" * 1_000)
+
+    transport = PackfileTransport()
+    peer = b"\x04" * 32
+    calls = {"n": 0}
+
+    async def fake_get_peer(orch, estimate, fulfilled, last_request,
+                            min_free=1):
+        calls["n"] += 1
+        # first acquisition: peer only has room for the small file;
+        # afterwards plenty, so the loop can finish
+        return transport, peer, (2_000 if calls["n"] == 1 else 1 << 30)
+
+    engine._get_peer_connection = fake_get_peer
+    orch = Orchestrator()
+    orch.packing_completed = True
+    orch.buffer_bytes = 11_000
+
+    async def run():
+        await asyncio.wait_for(engine._send_loop(orch, 0), timeout=10)
+
+    asyncio.new_event_loop().run_until_complete(run())
+    # the small file went out on the FIRST peer (no livelock), the big one
+    # on the second acquisition
+    assert transport.sent == [small_id, big_id]

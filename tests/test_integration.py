@@ -298,3 +298,59 @@ def test_three_client_disjoint_restore(tmp_path, loop, monkeypatch):
         await server.stop()
 
     loop.run_until_complete(asyncio.wait_for(run(), 300))
+
+def test_restore_tolerates_phantom_negotiated_peer(tmp_path, loop,
+                                                   monkeypatch):
+    """A phantom negotiation (server crashed between record and notify —
+    see the matcher's crash-window note in net/server.py) lists a peer
+    that stores nothing for us and refuses our dial.  Restore must still
+    succeed when the remaining peers' data covers the snapshot."""
+    from backuwup_tpu import defaults
+
+    monkeypatch.setattr(defaults, "STORAGE_REQUEST_RETRY_S", 0.2)
+    monkeypatch.setattr(defaults, "RESTORE_REQUEST_THROTTLE_S", 0.0)
+
+    rng = random.Random(99)
+    src = tmp_path / "a_src"
+    src.mkdir()
+    files = _corpus(src, rng, "phantom")
+
+    async def run():
+        server = CoordinationServer(db_path=str(tmp_path / "server.db"))
+        port = await server.start()
+        addr = f"127.0.0.1:{port}"
+
+        def make_app(name, path):
+            app = ClientApp(config_dir=tmp_path / name / "cfg",
+                            data_dir=tmp_path / name / "data",
+                            server_addr=addr, backend=CpuBackend(SMALL))
+            app.store.set_backup_path(str(path))
+            return app
+
+        b_src = tmp_path / "b_src"
+        b_src.mkdir()
+        (b_src / "x.bin").write_bytes(rng.randbytes(500_000))
+        a, b = make_app("a", src), make_app("b", b_src)
+        await a.start()
+        await b.start()
+        await asyncio.wait_for(asyncio.gather(a.backup(), b.backup()), 120)
+
+        # c registers but never exchanges data with a — then the server
+        # "crashes" mid-match, leaving only the phantom DB record
+        c = make_app("c", b_src)
+        await c.start()
+        server.db.save_storage_negotiated(a.client_id, c.client_id, 50_000)
+        server.db.save_storage_negotiated(c.client_id, a.client_id, 50_000)
+
+        shutil.rmtree(src)
+        dest = tmp_path / "a_restored"
+        restored = await asyncio.wait_for(a.restore(dest), 120)
+        for rel, data in files.items():
+            assert (restored / rel).read_bytes() == data, rel
+
+        await a.stop()
+        await b.stop()
+        await c.stop()
+        await server.stop()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 120))
