@@ -214,7 +214,7 @@ class Engine:
         # the size estimate walks the whole tree: keep it off the event
         # loop (backup/mod.rs:207-238 runs it blocking; we cannot)
         estimate = await loop.run_in_executor(None, self.estimate_size, root)
-        orch.buffer_bytes = self._buffer_bytes()  # leftovers from past runs
+        orch.set_buffer(self._buffer_bytes())  # leftovers from past runs
         self._log(f"backup started, estimated {estimate} bytes")
         self._progress(size_estimate=estimate, running=True)
         snapshot_holder: dict = {}

@@ -301,24 +301,31 @@ def scan_words_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray,
 
 @functools.partial(jax.jit, static_argnames=(
     "min_size", "desired_size", "max_size", "mask_s", "mask_l",
-    "s_cap", "l_cap", "cut_cap"))
+    "s_cap", "l_cap", "cut_cap", "fused"))
 def scan_select_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
                       min_size: int, desired_size: int, max_size: int,
                       mask_s: int, mask_l: int,
-                      s_cap: int, l_cap: int, cut_cap: int) -> jnp.ndarray:
+                      s_cap: int, l_cap: int, cut_cap: int,
+                      fused: bool = False) -> jnp.ndarray:
     """Fused gear scan + FastCDC cut selection, fully on device.
 
     ``(B, _HALO+P) u8 -> (B, 2+cut_cap) i32`` packed per row as
     ``[overflow, n_cuts, inclusive chunk end positions...]``.  This is the
     whole CDC front end in ONE dispatch: hashes via the doubling ladder,
     candidate compaction via fixed-capacity ``nonzero``, and the
-    min/desired/max two-mask greedy selection (bit-identical to
-    :func:`backuwup_tpu.ops.cdc_cpu.select_cuts`) as a ``lax.while_loop``
-    over the sparse candidates — so the only download a caller needs is the
-    tiny packed cut list, instead of candidate words plus a host selection
-    pass plus a chunk-meta re-upload.  ``overflow`` flags candidate counts
-    beyond the sparse capacity (adversarial data); such rows must be
-    re-chunked by the oracle.
+    min/desired/max two-mask selection (bit-identical to
+    :func:`backuwup_tpu.ops.cdc_cpu.select_cuts`) over the sparse
+    candidates — so the only download a caller needs is the tiny packed
+    cut list, instead of candidate words plus a host selection pass plus a
+    chunk-meta re-upload.  ``overflow`` flags candidate counts beyond the
+    sparse capacity (adversarial data); such rows must be re-chunked by
+    the oracle.
+
+    With ``fused=True`` the hash+mask+pack front end runs as the Mosaic
+    strip kernel (:func:`backuwup_tpu.ops.scan_fused.fused_candidate_words`,
+    ~7x less wall clock than the XLA ladder); callers gate on
+    :func:`backuwup_tpu.ops.scan_fused.fused_scan_available`, which
+    parity-checks the kernel against the XLA path on the live runtime.
     """
     P = ext_b.shape[1] - _HALO
     ms = jnp.uint32(mask_s)
@@ -328,36 +335,54 @@ def scan_select_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
     # nearly every candidate lands in its own 32-bit word on real data
     w_cap = max(512, min(l_cap, P // 32 if P >= 32 else 1))
 
-    def compact_both(cand_l, cand_s):
-        """Fixed-capacity (pos_l, is_s-derived pos_s) via TWO-LEVEL
-        compaction, paying the expensive pass only once.
+    # block pyramid for the compaction: a direct fixed-capacity nonzero
+    # over all P/32 words pays a full-length cumsum (~30+ ms on a 256 MiB
+    # segment); reducing 128-word blocks to any-flags first shrinks the
+    # expensive cumsums to (P/4096) + (b_cap*128) lanes.
+    n_words = (P + 31) // 32
+    blk = 128
+    while blk > 1 and n_words % blk:
+        blk //= 2
+    nblk = n_words // blk
+    b_cap = min(nblk, max(512, w_cap // 4))
+
+    def compact_words(words_l, words_s):
+        """Fixed-capacity (pos_l, is_s-derived pos_s) from packed
+        candidate words via THREE-LEVEL compaction.
 
         A direct ``jnp.nonzero`` over the full position axis costs seconds
         on a 128 MiB segment (measured: the cumsum+scatter over 1.3e8
-        lanes dominates the whole pipeline); packing candidate bits 32:1
-        into u32 words first makes the expensive nonzero 32x smaller, and
-        the second-level expansion works on ``w_cap*32`` lanes only.  The
-        strict mask's bits ride along through the SAME compaction (its
-        candidates are a subset of the loose ones), so only one
-        word-level nonzero and zero full-axis reductions are needed.
+        lanes dominates the whole pipeline).  Candidate bits arrive packed
+        32:1 into u32 words; word blocks reduce to any-flags whose
+        ``nonzero`` is tiny, surviving blocks' words are gathered and
+        compacted at ``w_cap``, and the final expansion works on
+        ``w_cap*32`` lanes.  The strict mask's bits ride along through the
+        SAME compaction (its candidates are a subset of the loose ones),
+        so no full-axis cumsum or reduction remains.
         """
-        rem = (-cand_l.shape[0]) % 32
-        if rem:
-            pad = jnp.zeros(rem, dtype=cand_l.dtype)
-            cand_l = jnp.concatenate([cand_l, pad])
-            cand_s = jnp.concatenate([cand_s, pad])
-        words_l = _pack_bits(cand_l)
-        words_s = _pack_bits(cand_s)
-        nzw = words_l != 0
-        (widx,) = jnp.nonzero(nzw, size=w_cap, fill_value=words_l.shape[0])
-        wsafe = jnp.clip(widx, 0, words_l.shape[0] - 1)
-        in_range = widx < words_l.shape[0]
-        bits_l = jnp.where(in_range, words_l[wsafe], jnp.uint32(0))
-        bits_s = jnp.where(in_range, words_s[wsafe], jnp.uint32(0))
+        wl2 = words_l.reshape(nblk, blk)
+        ws2 = words_s.reshape(nblk, blk)
+        any_b = jnp.any(wl2 != 0, axis=1)
+        (bidx,) = jnp.nonzero(any_b, size=b_cap, fill_value=nblk)
+        bsafe = jnp.clip(bidx, 0, nblk - 1)
+        in_b = (bidx < nblk)[:, None]
+        sub_l = jnp.where(in_b, wl2[bsafe], jnp.uint32(0)).reshape(-1)
+        sub_s = jnp.where(in_b, ws2[bsafe], jnp.uint32(0)).reshape(-1)
+        # word index (in the full array) of each gathered sub-word
+        sub_widx = (bidx[:, None].astype(jnp.int32) * blk
+                    + jnp.arange(blk, dtype=jnp.int32)[None, :]).reshape(-1)
+        nzw = sub_l != 0
+        sub_n = sub_l.shape[0]
+        (wsel,) = jnp.nonzero(nzw, size=w_cap, fill_value=sub_n)
+        wsafe = jnp.clip(wsel, 0, sub_n - 1)
+        in_range = wsel < sub_n
+        bits_l = jnp.where(in_range, sub_l[wsafe], jnp.uint32(0))
+        bits_s = jnp.where(in_range, sub_s[wsafe], jnp.uint32(0))
+        widx = jnp.where(in_range, sub_widx[wsafe], n_words)
         lane = jnp.arange(32, dtype=jnp.int32)[None, :]
         has_l = ((bits_l[:, None] >> lane.astype(jnp.uint32)) & 1) == 1
         has_s = ((bits_s[:, None] >> lane.astype(jnp.uint32)) & 1) == 1
-        posmat = widx[:, None].astype(jnp.int32) * 32 + lane
+        posmat = widx[:, None] * 32 + lane
         flat_l = has_l.reshape(-1)
         flat_s = has_s.reshape(-1)
         # no masking needed: sel below only gathers flat_l-true lanes, and
@@ -373,17 +398,14 @@ def scan_select_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
         pos_s = jnp.where(ssel < l_cap,
                           pos_l[jnp.clip(ssel, 0, l_cap - 1)],
                           jnp.int32(P))
-        overflow = ((jnp.sum(nzw.astype(jnp.int32)) > w_cap)
+        overflow = ((jnp.sum(any_b.astype(jnp.int32)) > b_cap)
+                    | (jnp.sum(nzw.astype(jnp.int32)) > w_cap)
                     | (jnp.sum(flat_l.astype(jnp.int32)) > l_cap)
                     | (jnp.sum(is_s.astype(jnp.int32)) > s_cap))
         return pos_l, pos_s, overflow
 
-    def one(ext, n):
-        h = _hash_ext_fast(ext)
-        valid = jnp.arange(P, dtype=jnp.int32) < n
-        cand_l = ((h & ml) == 0) & valid
-        cand_s = cand_l & ((h & ms) == 0)
-        pos_l, pos_s, ovf = compact_both(cand_l, cand_s)
+    def one(n, words_l, words_s):
+        pos_l, pos_s, ovf = compact_words(words_l, words_s)
         overflow = ovf.astype(jnp.int32)
 
         def cond(st):
@@ -418,7 +440,19 @@ def scan_select_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
         _, n_cuts, cuts = jax.lax.while_loop(cond, body, (s0, k0, cuts0))
         return jnp.concatenate([overflow[None], n_cuts[None], cuts])
 
-    return jax.vmap(one)(ext_b, nv_b.astype(jnp.int32))
+    nv_i = nv_b.astype(jnp.int32)
+    if fused:
+        from .scan_fused import fused_candidate_words
+        wl_b, ws_b = fused_candidate_words(ext_b, nv_i,
+                                           mask_s=mask_s, mask_l=mask_l)
+    else:
+        def words_one(ext, n):
+            h = _hash_ext_fast(ext)
+            return _candidate_words(h, n, ms, ml)
+
+        wl_b, ws_b = jax.vmap(words_one)(ext_b, nv_i)
+
+    return jax.vmap(one)(nv_i, wl_b, ws_b)
 
 
 def unpack_scan_words(row, k_cap: int):

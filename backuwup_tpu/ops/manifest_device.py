@@ -1,0 +1,212 @@
+"""Zero-round-trip manifest: scan -> select -> gather -> digest on device.
+
+Round-3 profiling (scripts/probe_stages_honest.py) showed the pipeline's
+wall clock was **host-link latency, not device compute**: the driver
+downloaded each segment's cut list before it could stage digest tiles, so
+every batch paid two high-latency host round trips while the device sat
+idle (~100+ ms each on the relay-attached dev rig; real PCIe pays less
+but still serializes).  The reference has the same structure collapsed
+onto one CPU (``dir_packer.rs:246-311``): chunk, then hash, then index —
+all in one address space.  The TPU answer is to keep the *data plane*
+entirely in HBM:
+
+1. :func:`backuwup_tpu.ops.cdc_tpu.scan_select_batch` produces packed
+   per-row cut lists on device (Mosaic strip scan + on-device selection).
+2. Chunk meta (offset, length, class) is DERIVED on device from the cut
+   lists — no host assembly.
+3. Chunks are compacted into a small set of power-of-two length classes
+   (fixed-capacity ``nonzero``), gathered HBM->HBM at their class's
+   padded span, digested with the batched BLAKE3, and the root chaining
+   values scattered into one dense ``(B*cut_cap, 8)`` accumulator.
+4. The caller downloads ``(cuts, digests, overflow)`` once — for a whole
+   run of batches — and assembles manifests host-side.
+
+Class capacities are sized from a one-time oracle calibration of the
+chunk-length distribution (:func:`class_plan`); a class overflow (data
+far from the calibrated distribution, e.g. adversarial all-max chunks)
+sets a flag and the affected batch falls back to the host-tiled path,
+preserving bit-exact output.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .cdc_tpu import _HALO, scan_select_batch
+from .blake3_tpu import digest_padded
+from .gear import CDCParams
+
+CHUNK_LEN = 1024
+
+
+def _pow2_at_least(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+@functools.lru_cache(maxsize=16)
+def _length_histogram(params: CDCParams) -> Tuple[float, Tuple[float, ...]]:
+    """(mean_chunk_len, fraction per pow2 leaf class), computed
+    analytically from the two-phase geometric cut process.
+
+    On uniform data the gear hash at each position is iid uniform, so a
+    chunk survives past length ``x`` with probability
+    ``(1-p_s)^a (1-p_l)^b`` where ``a``/``b`` count positions seen by the
+    strict/loose windows and ``p = 2^-mask_bits``; the forced cut at
+    ``max_size`` truncates the tail.  Exact for random corpora; real
+    corpora that deviate far enough to overflow the 1.7x-slack capacities
+    fall back to the host-tiled path (still bit-exact), so this estimate
+    only steers throughput, never correctness.
+    """
+    p_s = 2.0 ** -params.mask_s_bits
+    p_l = 2.0 ** -params.mask_l_bits
+    lens = np.arange(params.min_size, params.max_size + 1, dtype=np.float64)
+    # positions examined by each phase for a chunk of length L (cuts land
+    # at L-1): strict window spans [min-1, desired-2], loose beyond
+    a = np.clip(lens - params.min_size + 1, 0,
+                params.desired_size - params.min_size)
+    b = np.clip(lens - params.desired_size + 1, 0, None)
+    surv = (1 - p_s) ** a * (1 - p_l) ** b
+    pmf = np.empty_like(surv)
+    pmf[:-1] = surv[:-1] - surv[1:]
+    pmf[-1] = surv[-1]  # forced cut at max_size absorbs the tail
+    pmf = np.maximum(pmf, 0)
+    pmf /= pmf.sum()
+    mean = float((lens * pmf).sum())
+    classes = class_leaf_sizes(params)
+    leaves = -(-lens // CHUNK_LEN)
+    fracs = []
+    for i, c in enumerate(classes):
+        lo = classes[i - 1] if i else 0
+        fracs.append(float(pmf[(leaves > lo) & (leaves <= c)].sum()))
+    return mean, tuple(fracs)
+
+
+@functools.lru_cache(maxsize=16)
+def class_leaf_sizes(params: CDCParams) -> Tuple[int, ...]:
+    """Linear leaf-count class grid covering [1, max chunk leaves].
+
+    ~12 classes bound per-chunk padding waste to one class step (~8% of
+    ``max_size``) — pow2 classes measured ~2x padded-digest overcompute
+    because most mass lands just above a boundary.
+    """
+    max_leaves = -(-params.max_size // CHUNK_LEN)
+    step = max(8, -(-max_leaves // 12))
+    step = -(-step // 8) * 8  # aligned steps keep tile shapes friendly
+    out = list(range(step, max_leaves + 1, step))
+    if not out or out[-1] != max_leaves:
+        out.append(max_leaves)
+    return tuple(out)
+
+
+@functools.lru_cache(maxsize=64)
+def class_caps(params: CDCParams, total_bytes: int,
+               n_rows: int) -> Tuple[int, ...]:
+    """Per-class chunk-slot capacities for one batch shape.
+
+    Expectation + 3 sigma (binomial) + slack; class 0 additionally holds
+    every row's short tail.  Digest compute scales with cap x class span,
+    so slack is deliberately tight; an overflow is detected on device and
+    the batch re-runs on the host-tiled path (bit-exact either way).
+    """
+    mean_len, fracs = _length_histogram(params)
+    expect_total = total_bytes / max(mean_len, 1.0)
+    caps = []
+    for i, frac in enumerate(fracs):
+        mu = expect_total * frac
+        sigma = (expect_total * frac * (1.0 - frac)) ** 0.5
+        want = mu + 0.75 * sigma + 1 + (n_rows if i == 0 else 0)
+        if i == len(fracs) - 1:
+            want += 8 + 0.02 * expect_total  # cascade terminus slack
+        elif mu < 1.5 and i > 0:
+            # near-empty class: skip its digest tile entirely, the
+            # cascade hands its rare chunks one span class up
+            want = 0
+        caps.append(-(-int(want) // 4) * 4)
+    return tuple(caps)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "min_size", "desired_size", "max_size", "mask_s", "mask_l",
+    "s_cap", "l_cap", "cut_cap", "fused", "classes", "caps"))
+def scan_digest_batch(buf_d: jnp.ndarray, nv_b: jnp.ndarray, *,
+                      min_size: int, desired_size: int, max_size: int,
+                      mask_s: int, mask_l: int, s_cap: int, l_cap: int,
+                      cut_cap: int, fused: bool,
+                      classes: Tuple[int, ...], caps: Tuple[int, ...]):
+    """One resident ``(B, _HALO+P)`` batch -> (packed cuts, digests, ovf).
+
+    Everything stays on device: ``packed`` is ``scan_select_batch``'s
+    ``(B, 2+cut_cap)`` cut rows, ``digests`` is ``(B*cut_cap, 8)`` u32
+    root chaining values addressed by ``row*cut_cap + chunk``, ``ovf`` is
+    ``(1,)`` i32 — the number of chunks the cascade could not place
+    (nonzero means the caller must fall back; see cascade note below).
+    """
+    B = buf_d.shape[0]
+    row_len = buf_d.shape[1]
+    packed = scan_select_batch(
+        buf_d, nv_b, min_size=min_size, desired_size=desired_size,
+        max_size=max_size, mask_s=mask_s, mask_l=mask_l,
+        s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap, fused=fused)
+
+    # --- chunk meta from cut lists, on device ------------------------------
+    n_cuts = packed[:, 1]  # (B,)
+    ends = packed[:, 2:]   # (B, cut_cap) inclusive ends, -1 padded
+    offs = jnp.concatenate(
+        [jnp.zeros((B, 1), dtype=ends.dtype), ends[:, :-1] + 1], axis=1)
+    lens = ends - offs + 1
+    valid = (jnp.arange(cut_cap, dtype=jnp.int32)[None, :]
+             < n_cuts[:, None])  # (B, cut_cap)
+    lens = jnp.where(valid, lens, 0)
+    # absolute byte offset of each chunk in the flattened batch buffer
+    row_base = (jnp.arange(B, dtype=jnp.int32) * row_len + _HALO)[:, None]
+    abs_offs = (row_base + offs).reshape(-1)
+    flat_lens = lens.reshape(-1)
+    flat_valid = valid.reshape(-1)
+    total = B * cut_cap
+
+    leaves = (flat_lens + (CHUNK_LEN - 1)) // CHUNK_LEN
+    # class id = index of smallest class >= leaves (valid chunks only)
+    cls = jnp.zeros(total, dtype=jnp.int32)
+    for i, c in enumerate(classes[:-1]):
+        cls = cls + (leaves > c).astype(jnp.int32)
+
+    flat = buf_d.reshape(-1)
+    # slack so fixed-span gathers never clamp (dynamic_slice clips
+    # out-of-range starts, which would shift data)
+    flat = jnp.pad(flat, (0, classes[-1] * CHUNK_LEN))
+    acc = jnp.zeros((total, 8), dtype=jnp.uint32)
+    # cascade spill: a class beyond its capacity hands its excess chunks
+    # to the next (larger-span) class, so per-class capacities stay at
+    # ~expectation and only total-count fluctuation can reach the top
+    carry = jnp.zeros(total, dtype=bool)
+    for i, (Lc, cap) in enumerate(zip(classes, caps)):
+        if cap == 0:  # skipped class: cascade everything upward
+            carry = carry | (flat_valid & (cls == i))
+            continue
+        mine = flat_valid & ((cls == i) | carry)
+        rank = jnp.cumsum(mine.astype(jnp.int32)) - 1
+        take = mine & (rank < cap)
+        carry = mine & ~take
+        (idx,) = jnp.nonzero(take, size=cap, fill_value=total)
+        safe = jnp.clip(idx, 0, total - 1)
+        got = idx < total
+        o = jnp.where(got, abs_offs[safe], 0)
+        ln = jnp.where(got, flat_lens[safe], 0)
+        span = Lc * CHUNK_LEN
+
+        def one(off):
+            return jax.lax.dynamic_slice(flat, (off,), (span,))
+
+        tile = jax.vmap(one)(o)
+        cv = digest_padded(tile, ln, L=Lc)  # (cap, 8)
+        acc = acc.at[idx].set(cv, mode="drop")
+    ovf = jnp.sum(carry.astype(jnp.int32))[None]  # terminus overflow only
+    return packed, acc, ovf

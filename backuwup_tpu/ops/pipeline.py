@@ -62,6 +62,30 @@ def _pad_to(arr: np.ndarray, n: int) -> np.ndarray:
     return out
 
 
+def _blake3_host(data: bytes) -> bytes:
+    from .blake3_cpu import blake3_hash
+    return blake3_hash(data)
+
+
+def _decode_cut_row(row: np.ndarray):
+    """One packed scan+select row -> (overflow, [(offset, length)...]).
+
+    Shared by every collector so the cut decode exists exactly once.
+    Vectorized: the python per-chunk loop dominated many-small-file
+    batches.
+    """
+    overflow, n_cuts = int(row[0]), int(row[1])
+    if overflow:
+        return True, []
+    ends = row[2:2 + n_cuts].astype(np.int64)
+    offs = np.empty(n_cuts, dtype=np.int64)
+    if n_cuts:
+        offs[0] = 0
+        np.add(ends[:-1], 1, out=offs[1:])
+    lens = ends - offs + 1
+    return False, list(zip(offs.tolist(), lens.tolist()))
+
+
 def _async_to_host(arr) -> None:
     """Start a device->host copy in the background when the runtime
     supports it; ``np.asarray`` later completes (or performs) it."""
@@ -168,6 +192,8 @@ class DevicePipeline:
         self.l_bucket = l_bucket
         self.b_bucket = b_bucket
         self._nv_cache: dict = {}
+        from .scan_fused import fused_scan_available
+        self.fused = fused_scan_available()
 
     # --- scan + select (device) -------------------------------------------
 
@@ -200,7 +226,7 @@ class DevicePipeline:
                 buf_d, self._nv_device(nv),
                 min_size=p.min_size, desired_size=p.desired_size,
                 max_size=p.max_size, mask_s=p.mask_s, mask_l=p.mask_l,
-                s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap)
+                s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap, fused=self.fused)
         _async_to_host(packed_d)
         return packed_d
 
@@ -218,7 +244,7 @@ class DevicePipeline:
         nv = np.asarray(nv, dtype=np.int32)
         per_row: List[List[tuple]] = []
         for r in range(packed.shape[0]):
-            overflow, n_cuts = int(packed[r, 0]), int(packed[r, 1])
+            overflow, chunks = _decode_cut_row(packed[r])
             if overflow:
                 if strict_overflow:
                     raise RuntimeError("candidate overflow in scan+select")
@@ -226,15 +252,7 @@ class DevicePipeline:
                     buf_d[r, _HALO:_HALO + int(nv[r])]))
                 per_row.append(chunk_stream_cpu(row_bytes, self.params))
             else:
-                # vectorized cuts -> (offset, length) pairs: the python
-                # per-chunk loop dominated many-small-file batches
-                ends = packed[r, 2:2 + n_cuts].astype(np.int64)
-                offs = np.empty(n_cuts, dtype=np.int64)
-                if n_cuts:
-                    offs[0] = 0
-                    np.add(ends[:-1], 1, out=offs[1:])
-                lens = ends - offs + 1
-                per_row.append(list(zip(offs.tolist(), lens.tolist())))
+                per_row.append(chunks)
         return per_row
 
     # --- gather + digest (device) -----------------------------------------
@@ -368,6 +386,88 @@ class DevicePipeline:
             while digs and (len(digs) >= 2 or not scans):
                 per_row, pending = digs.popleft()
                 yield self.digest_collect(pending, per_row)
+
+    def manifest_segments_device(self, segments, strict_overflow: bool = False,
+                                 window: int = 4):
+        """Zero-round-trip pipelined driver (generator).
+
+        Unlike :meth:`manifest_segments` (which downloads each batch's cut
+        list before staging digest tiles — two host round trips per batch,
+        the measured wall-clock floor on high-latency links), every stage
+        here runs on device via
+        :func:`backuwup_tpu.ops.manifest_device.scan_digest_batch`; the
+        only downloads are the packed cuts + digest accumulator, whose
+        async copies overlap later batches' compute.  ``window`` bounds
+        batches in flight (HBM high-water).
+
+        Overflow handling preserves bit-exactness: a row whose sparse
+        candidate capacity overflowed re-chunks on the CPU oracle; a batch
+        whose class capacities overflowed re-runs on the host-tiled path.
+        """
+        from .manifest_device import class_caps, class_leaf_sizes, scan_digest_batch
+
+        p = self.params
+        classes = class_leaf_sizes(p)
+        it = iter(segments)
+        pending: deque = deque()
+
+        def dispatch():
+            for buf_d, nv in it:
+                padded = int(buf_d.shape[1]) - _HALO
+                s_cap, l_cap, cut_cap = self._caps(padded)
+                caps = class_caps(p, int(buf_d.shape[0]) * padded,
+                                  int(buf_d.shape[0]))
+                with tracing.span("pipeline.scan_digest_dispatch"):
+                    packed, acc, ovf = scan_digest_batch(
+                        buf_d, self._nv_device(nv),
+                        min_size=p.min_size, desired_size=p.desired_size,
+                        max_size=p.max_size, mask_s=p.mask_s,
+                        mask_l=p.mask_l, s_cap=s_cap, l_cap=l_cap,
+                        cut_cap=cut_cap, fused=self.fused,
+                        classes=classes, caps=caps)
+                for a in (packed, acc, ovf):
+                    _async_to_host(a)
+                pending.append((buf_d, nv, cut_cap, packed, acc, ovf))
+                return True
+            return False
+
+        for _ in range(window):
+            dispatch()
+        while pending:
+            buf_d, nv, cut_cap, packed_d, acc_d, ovf_d = pending.popleft()
+            dispatch()
+            with tracing.span("pipeline.scan_digest_collect"):
+                packed = np.asarray(packed_d)
+                ovf = np.asarray(ovf_d)
+            if ovf.any():
+                if strict_overflow:
+                    raise RuntimeError("class capacity overflow in "
+                                       "device manifest")
+                # recalibrated path: host-tiled pipeline, still exact
+                yield self.manifest_resident_batch(buf_d, nv)
+                continue
+            acc = np.asarray(acc_d)
+            dig8 = np.ascontiguousarray(acc.astype("<u4")).view(
+                np.uint8).reshape(-1, cut_cap, 32)
+            out = []
+            nv = np.asarray(nv, dtype=np.int32)
+            for r in range(packed.shape[0]):
+                overflow, chunks = _decode_cut_row(packed[r])
+                if overflow:
+                    if strict_overflow:
+                        raise RuntimeError(
+                            "candidate overflow in scan+select")
+                    row = bytes(np.asarray(
+                        buf_d[r, _HALO:_HALO + int(nv[r])]))
+                    chunks = chunk_stream_cpu(row, self.params)
+                    digs = np.stack([np.frombuffer(
+                        _blake3_host(row[o:o + ln]), dtype=np.uint8)
+                        for o, ln in chunks]) if chunks else \
+                        np.zeros((0, 32), dtype=np.uint8)
+                    out.append((chunks, digs))
+                    continue
+                out.append((chunks, dig8[r, :len(chunks)].copy()))
+            yield out
 
     def process_segment(self, stream: jnp.ndarray, n_valid: int,
                         prev_tail: bytes = b"") -> Tuple[List[tuple], np.ndarray]:

@@ -1,0 +1,199 @@
+"""Fused Mosaic/Pallas CDC scan: gear + ladder + candidate masks in VMEM.
+
+The XLA scan (:func:`.cdc_tpu._hash_ext_fast`) pays HBM for every pass:
+the fmix32 gear values and each of the five doubling-ladder passes
+materialize a u32 array the size of 4x the stream (~45 bytes of HBM
+traffic per stream byte, the measured ~200 ms/256 MiB floor).  This
+kernel runs the whole scan per VMEM-resident tile and writes only the
+packed candidate words (1/4 byte per stream byte), so HBM traffic drops
+to ~1.3 bytes per stream byte — within striking distance of the
+bandwidth floor.
+
+Layout — the **strip decomposition** (PERF.md round-4 direction 2): the
+P-byte stream is split into 128 contiguous strips of S = P/128 bytes;
+strip ``l`` occupies lane ``l`` of a ``(S, 128)`` u8 array with stream
+position ``l*S + r`` at row ``r``.  A shift by ``s`` positions is then a
+pure **sublane** shift (rows), never a lane relayout — the failure mode
+that sank round 3's flat-layout ladder kernel (~100-130 ms; PERF.md
+"dead ends").  Each strip carries a 32-byte halo of the previous strip's
+tail (real bytes, so hashes at strip starts are exact; only global
+position 0 sees the spec's zero halo), and each grid step's tile carries
+a 32-row halo of the previous tile via a second clamped BlockSpec.
+
+Against the reference: this is the TPU replacement for the byte-at-a-time
+FastCDC hot loop in ``client/src/backup/filesystem/dir_packer.rs:246-266``.
+
+Output contract: ``(B, P/32) u32`` candidate words in **position-major
+order** (word ``w`` bit ``t`` = candidate at position ``w*32 + t``) —
+bit-identical to ``_pack_bits(cand)`` of the XLA path, so the two-level
+compaction and the on-device cut selection consume either
+interchangeably (tests assert equality; bench parity-gates end to end).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .gear import GEAR_SEED32
+
+_LANES = 128
+_HALO_ROWS = 32  # 31 context bytes + 1 alignment row (u8 tile = 32 sublanes)
+_DEF_R = 2048  # strip rows per grid step (VMEM working set ~5 MiB)
+
+try:  # CPU-only runs never lower the kernel; import is all they need
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+
+
+def _fmix32_u32(x):
+    h = x + jnp.uint32(GEAR_SEED32)
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def _make_scan_kernel(mask_s: int, mask_l: int, S: int, R: int):
+    def kernel(nv_ref, halo0_ref, main_ref, prev_ref, wl_ref, ws_ref):
+        b = pl.program_id(0)
+        i = pl.program_id(1)
+        # tile halo: previous tile's last 32 strip rows; tile 0 uses the
+        # cross-strip halo input (real bytes of each strip's predecessor)
+        halo = jnp.where(i > 0, prev_ref[0], halo0_ref[0])
+        byts = jnp.concatenate([halo, main_ref[0]], axis=0)  # (R+32, 128) u8
+        a = _fmix32_u32(byts.astype(jnp.uint32))
+        # 32-tap windowed gear sum by log-doubling; shifts are sublane moves
+        for t in range(5):
+            s = 1 << t
+            shifted = jnp.concatenate(
+                [jnp.zeros((s, _LANES), dtype=jnp.uint32), a[:-s]], axis=0)
+            a = a + (shifted << jnp.uint32(s))
+        h = a[_HALO_ROWS:]  # (R, 128): main rows, taps all real (halo >= 31)
+        pos = (jax.lax.broadcasted_iota(jnp.int32, (R, _LANES), 1) * S
+               + i * R
+               + jax.lax.broadcasted_iota(jnp.int32, (R, _LANES), 0))
+        valid = pos < nv_ref[b]
+        cand_l = (((h & jnp.uint32(mask_l)) == jnp.uint32(0)) & valid)
+        cand_s = cand_l & ((h & jnp.uint32(mask_s)) == jnp.uint32(0))
+        # pack 32 strip rows into one u32 word row (little-endian bit t =
+        # row offset t), still lane-per-strip
+        cl = cand_l.astype(jnp.uint32).reshape(R // 32, 32, _LANES)
+        cs = cand_s.astype(jnp.uint32).reshape(R // 32, 32, _LANES)
+        wl = jnp.zeros((R // 32, _LANES), dtype=jnp.uint32)
+        ws = jnp.zeros((R // 32, _LANES), dtype=jnp.uint32)
+        for t in range(32):
+            wl = wl | (cl[:, t, :] << jnp.uint32(t))
+            ws = ws | (cs[:, t, :] << jnp.uint32(t))
+        wl_ref[0] = wl
+        ws_ref[0] = ws
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("mask_s", "mask_l"))
+def fused_candidate_words(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
+                          mask_s: int, mask_l: int):
+    """``(B, 31+P) u8 -> ((B, P/32) u32, (B, P/32) u32)`` candidate words.
+
+    Drop-in producer of the loose/strict packed candidate-bit arrays in
+    position-major order (bit-identical to the XLA path's
+    ``_pack_bits(cand)``).  ``P`` must be a multiple of 4096 (every
+    production segment bucket is a power of two >= 64 KiB).
+    """
+    B, n = ext_b.shape
+    P = n - 31
+    assert P % (128 * 32) == 0, "P must be a multiple of 4096"
+    S = P // _LANES
+    R = _DEF_R if S % _DEF_R == 0 else S  # small buckets: one grid step
+    # strip matrix: strips[b, r, l] = ext32[b, 32 + l*S + r]
+    ext32 = jnp.pad(ext_b, ((0, 0), (1, 0)))
+    body = ext32[:, 32:].reshape(B, _LANES, S).transpose(0, 2, 1)  # (B,S,128)
+    # cross-strip halo: 32 bytes preceding each strip (strip l-1's tail;
+    # strip 0 gets the spec zero byte + the row's 31 halo bytes)
+    halo0 = jnp.concatenate(
+        [ext32[:, :32, None], body[:, S - 32:, :-1]], axis=2)  # (B, 32, 128)
+    nv = nv_b.astype(jnp.int32)
+
+    kernel = _make_scan_kernel(mask_s, mask_l, S, R)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, S // R),
+        in_specs=[
+            pl.BlockSpec((1, _HALO_ROWS, _LANES), lambda b, i, *_: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, R, _LANES), lambda b, i, *_: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            # previous tile's last 32 rows: block index in 32-row units,
+            # clamped at 0 (tile 0 substitutes halo0 in-kernel)
+            pl.BlockSpec((1, _HALO_ROWS, _LANES),
+                         lambda b, i, *_: (b, jnp.maximum(
+                             i * (R // _HALO_ROWS) - 1, 0), 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, R // 32, _LANES), lambda b, i, *_: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, R // 32, _LANES), lambda b, i, *_: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+    wl, ws = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((B, S // 32, _LANES), jnp.uint32),
+                   jax.ShapeDtypeStruct((B, S // 32, _LANES), jnp.uint32)],
+        grid_spec=grid_spec,
+    )(nv, halo0, body, body)
+    # strip-major -> position-major: word (w, l) covers positions
+    # l*S + w*32 ..+31, so transposing to (l, w) and flattening yields
+    # flat word index j with base position j*32 — the _pack_bits order.
+    wl = wl.transpose(0, 2, 1).reshape(B, P // 32)
+    ws = ws.transpose(0, 2, 1).reshape(B, P // 32)
+    return wl, ws
+
+
+@functools.lru_cache(maxsize=1)
+def fused_scan_available() -> bool:
+    """True when the fused scan kernel lowers and matches the XLA oracle
+    on this runtime (checked once, on first use)."""
+    import os
+
+    if os.environ.get("BKW_FUSED", "1") == "0":
+        return False
+    if pl is None:
+        return False
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:  # pragma: no cover
+        return False
+    if platform not in ("tpu", "axon"):
+        return False
+    try:
+        import numpy as np
+
+        from .cdc_tpu import _candidate_words, _hash_ext_fast
+
+        rng = np.random.default_rng(7)
+        P = 64 * 1024
+        ext = rng.integers(0, 256, (2, 31 + P), dtype=np.uint8)
+        nv = np.array([P, P - 12345], dtype=np.int32)
+        mask_s, mask_l = 0xFFF00000, 0xFFF80000
+        wl, ws = fused_candidate_words(jnp.asarray(ext), jnp.asarray(nv),
+                                       mask_s=mask_s, mask_l=mask_l)
+        for r in range(2):
+            h = _hash_ext_fast(jnp.asarray(ext[r]))
+            rl, rs = _candidate_words(h, jnp.int32(nv[r]),
+                                      jnp.uint32(mask_s), jnp.uint32(mask_l))
+            if not (np.array_equal(np.asarray(wl[r]), np.asarray(rl))
+                    and np.array_equal(np.asarray(ws[r]), np.asarray(rs))):
+                return False
+        return True
+    except Exception:  # pragma: no cover - lowering failure
+        return False

@@ -1,0 +1,62 @@
+"""Wall-clock of the zero-round-trip device driver on the live rig."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    from backuwup_tpu.utils.jaxcache import enable_compilation_cache
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from backuwup_tpu.ops.cdc_tpu import _HALO
+    from backuwup_tpu.ops.gear import CDCParams
+    from backuwup_tpu.ops.pipeline import DevicePipeline
+
+    n_seg = int(os.environ.get("N_SEG", "12"))
+    seg_mib = 256
+    P = seg_mib << 20
+    params = CDCParams()
+    pipe = DevicePipeline(params)
+    print("fused:", pipe.fused)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def synth(key):
+        seg = jax.random.randint(key, (P,), 0, 256, dtype=jnp.uint8)
+        return jnp.concatenate([jnp.zeros(_HALO, dtype=jnp.uint8), seg]
+                               ).reshape(1, _HALO + P)
+
+    nv = np.full(1, P, dtype=np.int32)
+
+    # warm both drivers on two segments
+    key, k1, k2 = jax.random.split(key, 3)
+    warm = [(synth(k1), nv), (synth(k2), nv)]
+    list(pipe.manifest_segments_device(iter(warm), strict_overflow=True))
+    list(pipe.manifest_segments(iter(warm), strict_overflow=True))
+
+    corpus = []
+    for _ in range(n_seg):
+        key, sub = jax.random.split(key)
+        corpus.append((synth(sub), nv))
+    jax.block_until_ready([b for b, _ in corpus])
+    # force real settle: download one byte of the last segment
+    np.asarray(corpus[-1][0][0, -1])
+
+    for name, driver in (("device(0-rt)", pipe.manifest_segments_device),
+                         ("host-tiled", pipe.manifest_segments)):
+        t0 = time.time()
+        res = list(driver(iter(corpus), strict_overflow=True))
+        dt = time.time() - t0
+        chunks = sum(len(c) for batch in res for c, _ in batch)
+        print(f"{name}: {n_seg}x{seg_mib} MiB in {dt:.2f}s = "
+              f"{n_seg*seg_mib/dt:.0f} MiB/s ({chunks} chunks)")
+
+
+if __name__ == "__main__":
+    main()

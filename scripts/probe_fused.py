@@ -1,0 +1,76 @@
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    from backuwup_tpu.utils.jaxcache import enable_compilation_cache
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from backuwup_tpu.ops.cdc_tpu import _candidate_words, _hash_ext_fast
+    from backuwup_tpu.ops.scan_fused import fused_candidate_words
+
+    print("devices:", jax.devices())
+    rng = np.random.default_rng(7)
+
+    # parity across sizes
+    for P in (64 * 1024, 1 << 20, 16 << 20):
+        ext = rng.integers(0, 256, (2, 31 + P), dtype=np.uint8)
+        nv = np.array([P, P - 12345], dtype=np.int32)
+        mask_s, mask_l = 0xFFF00000 & 0xFFFFFFFF, 0xFF800000
+        mask_s = (0xFFFFFFFF << (32 - 22)) & 0xFFFFFFFF
+        mask_l = (0xFFFFFFFF << (32 - 18)) & 0xFFFFFFFF
+        wl, ws = fused_candidate_words(jnp.asarray(ext), jnp.asarray(nv),
+                                       mask_s=mask_s, mask_l=mask_l)
+        ok = True
+        for r in range(2):
+            h = _hash_ext_fast(jnp.asarray(ext[r]))
+            rl, rs = _candidate_words(h, jnp.int32(nv[r]),
+                                      jnp.uint32(mask_s), jnp.uint32(mask_l))
+            el = np.array_equal(np.asarray(wl[r]), np.asarray(rl))
+            es = np.array_equal(np.asarray(ws[r]), np.asarray(rs))
+            ok = ok and el and es
+            if not (el and es):
+                a, b = np.asarray(wl[r]), np.asarray(rl)
+                bad = np.nonzero(a != b)[0]
+                print(f"  P={P} row {r}: loose diff at words {bad[:5]} "
+                      f"(of {bad.size})", a[bad[:3]], b[bad[:3]])
+        print(f"P={P}: parity {'OK' if ok else 'FAIL'}")
+        if not ok:
+            return
+
+    # timing: 256 MiB single row
+    P = 256 << 20
+    ext = rng.integers(0, 256, (1, 31 + P), dtype=np.uint8)
+    nv = np.array([P], dtype=np.int32)
+    dev = jnp.asarray(ext)
+    jax.block_until_ready(dev)
+
+    def t_fused():
+        return fused_candidate_words(dev, jnp.asarray(nv),
+                                     mask_s=mask_s, mask_l=mask_l)
+
+    def t_xla():
+        h = _hash_ext_fast(dev[0])
+        return _candidate_words(h, jnp.int32(P), jnp.uint32(mask_s),
+                                jnp.uint32(mask_l))
+
+    for name, fn in (("fused", t_fused), ("xla", t_xla)):
+        out = fn()
+        jax.block_until_ready(out)
+        t0 = time.time()
+        for _ in range(3):
+            out = fn()
+            jax.block_until_ready(out)
+        dt = (time.time() - t0) / 3
+        print(f"{name}: {dt*1000:.1f} ms / 256 MiB = {256/dt:.0f} MiB/s")
+
+
+if __name__ == "__main__":
+    main()

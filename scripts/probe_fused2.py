@@ -1,0 +1,110 @@
+"""Break down fused-scan cost: transpose / halo build / kernel / out-transpose."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timeit(fn, *args, reps=5):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.time() - t0) / reps
+
+
+def main():
+    from backuwup_tpu.utils.jaxcache import enable_compilation_cache
+    enable_compilation_cache()
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from backuwup_tpu.ops import scan_fused as sf
+
+    P = 256 << 20
+    S = P // 128
+    rng = np.random.default_rng(7)
+    ext = rng.integers(0, 256, (1, 31 + P), dtype=np.uint8)
+    dev = jnp.asarray(ext)
+    jax.block_until_ready(dev)
+    mask_s = (0xFFFFFFFF << (32 - 22)) & 0xFFFFFFFF
+    mask_l = (0xFFFFFFFF << (32 - 18)) & 0xFFFFFFFF
+    nv = jnp.asarray(np.array([P], dtype=np.int32))
+
+    @jax.jit
+    def build(ext_b):
+        ext32 = jnp.pad(ext_b, ((0, 0), (1, 0)))
+        body = ext32[:, 32:].reshape(1, 128, S).transpose(0, 2, 1)
+        halo0 = jnp.concatenate(
+            [ext32[:, :32, None], body[:, S - 32:, :-1]], axis=2)
+        return body, halo0
+
+    body, halo0 = build(dev)
+    jax.block_until_ready((body, halo0))
+    print(f"build(transpose+halo): {timeit(build, dev)*1000:.1f} ms")
+
+    @functools.partial(jax.jit, static_argnames=("R",))
+    def kern_only(body, halo0, nv, R):
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        kernel = sf._make_scan_kernel(mask_s, mask_l, S, R)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1, S // R),
+            in_specs=[
+                pl.BlockSpec((1, 32, 128), lambda b, i, *_: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, R, 128), lambda b, i, *_: (b, i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 32, 128),
+                             lambda b, i, *_: (b, jnp.maximum(
+                                 i * (R // 32) - 1, 0), 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, R // 32, 128), lambda b, i, *_: (b, i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, R // 32, 128), lambda b, i, *_: (b, i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+        )
+        return pl.pallas_call(
+            kernel,
+            out_shape=[jax.ShapeDtypeStruct((1, S // 32, 128), jnp.uint32),
+                       jax.ShapeDtypeStruct((1, S // 32, 128), jnp.uint32)],
+            grid_spec=grid_spec,
+        )(nv, halo0, body, body)
+
+    for R in (1024, 2048, 4096, 8192):
+        if S % R:
+            continue
+        try:
+            dt = timeit(kern_only, body, halo0, nv, R)
+            print(f"kernel only R={R}: {dt*1000:.1f} ms = {256/dt:.0f} MiB/s")
+        except Exception as e:
+            print(f"R={R}: FAIL {str(e)[:200]}")
+
+    @jax.jit
+    def out_t(wl):
+        return wl.transpose(0, 2, 1).reshape(1, P // 32)
+
+    wl, ws = kern_only(body, halo0, nv, 2048)
+    jax.block_until_ready((wl, ws))
+    print(f"out transpose (one array): {timeit(out_t, wl)*1000:.1f} ms")
+
+    dt = timeit(jax.jit(functools.partial(
+        sf.fused_candidate_words, mask_s=mask_s, mask_l=mask_l)), dev, nv)
+    print(f"full fused_candidate_words: {dt*1000:.1f} ms = {256/dt:.0f} MiB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Honest per-stage device timings (chained-execution sync; see devtime.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from scripts.devtime import dev_time
+
+
+def main():
+    from backuwup_tpu.utils.jaxcache import enable_compilation_cache
+    enable_compilation_cache()
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from backuwup_tpu.ops.cdc_tpu import _HALO, scan_select_batch
+    from backuwup_tpu.ops.blake3_tpu import digest_padded
+    from backuwup_tpu.ops.gear import CDCParams
+    from backuwup_tpu.ops.pipeline import DevicePipeline
+    from backuwup_tpu.ops.scan_fused import fused_candidate_words
+
+    P = 256 << 20
+    params = CDCParams()
+    pipe = DevicePipeline(params)
+    print("fused available:", pipe.fused)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def synth(key):
+        seg = jax.random.randint(key, (P,), 0, 256, dtype=jnp.uint8)
+        return jnp.concatenate([jnp.zeros(_HALO, dtype=jnp.uint8), seg]
+                               ).reshape(1, _HALO + P)
+
+    buf = synth(key)
+    nv = jnp.asarray(np.full(1, P, dtype=np.int32))
+    print(f"synth: {dev_time(synth, key)*1000:.2f} ms")
+
+    # scan front end alone (fused kernel incl. transposes)
+    fw = jax.jit(functools.partial(fused_candidate_words,
+                                   mask_s=params.mask_s, mask_l=params.mask_l))
+    print(f"fused_candidate_words: {dev_time(fw, buf, nv)*1000:.2f} ms")
+
+    # full scan+select, fused and xla
+    s_cap, l_cap, cut_cap = pipe._caps(P)
+    for fused in (True, False):
+        fn = jax.jit(functools.partial(
+            scan_select_batch, min_size=params.min_size,
+            desired_size=params.desired_size, max_size=params.max_size,
+            mask_s=params.mask_s, mask_l=params.mask_l,
+            s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap, fused=fused))
+        print(f"scan_select_batch fused={fused}: "
+              f"{dev_time(fn, buf, nv)*1000:.2f} ms")
+
+    # digest: gather+digest of 256 chunks x 1 MiB from the resident stream
+    n_chunks = 256
+    offs = jnp.asarray((np.arange(n_chunks) * (1 << 20)).astype(np.int32))
+    lens = jnp.asarray(np.full(n_chunks, 1 << 20, dtype=np.int32))
+    flat = jnp.pad(buf.reshape(-1), (0, 3072 * 1024))
+
+    @functools.partial(jax.jit, static_argnames=("L",))
+    def gd(flat, offs, lens, L):
+        def one(off):
+            return jax.lax.dynamic_slice(flat, (off,), (L * 1024,))
+        b = jax.vmap(one)(offs)
+        return digest_padded(b, lens, L=L)
+
+    for L, B in ((1024, 256), (2048, 128), (3072, 128)):
+        o = offs[:B]
+        ln = lens[:B]
+        dt = dev_time(gd, flat, o, ln, L)
+        mib = B * L / 1024
+        print(f"gather+digest B={B} L={L}: {dt*1000:.2f} ms "
+              f"({mib/max(dt,1e-9)/1024:.2f} GiB/s of padded bytes)")
+
+
+if __name__ == "__main__":
+    main()
