@@ -1,0 +1,35 @@
+# Coordination-server container (client image built from the same base).
+#
+# Mirrors the reference's server image (/root/reference/Dockerfile:1-25)
+# in spirit: a slim runtime with only what `python -m backuwup_tpu
+# server` needs.  The server's compute path is pure asyncio + SQLite —
+# no JAX and no accelerator required — so the image installs only
+# aiohttp + cryptography + numpy.  The CLIENT, whose dedup pipeline
+# wants an accelerator, normally runs on the host against a real TPU; a
+# CPU-only client container (native-C fast path) can be started from the
+# same image with `BKW_ROLE=client`.
+
+FROM python:3.12-slim AS runtime
+ARG ROLE=server
+WORKDIR /app
+
+# gcc/make: the client role's native C fast path builds at first use;
+# libzstd powers packfile compression (ctypes binding, no pip package)
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    gcc make libzstd1 zstd && rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir aiohttp cryptography numpy websockets
+
+COPY backuwup_tpu /app/backuwup_tpu
+
+ENV BKW_ROLE=${ROLE}
+ENV SERVER_BIND=0.0.0.0:9999
+ENV SERVER_DB=/data/server.db
+VOLUME /data
+EXPOSE 9999
+
+# server: coordination server on :9999 (TLS via TLS_CERT_FILE/TLS_KEY_FILE)
+# client: set BKW_ROLE=client, SERVER_ADDR, CONFIG_DIR=/data and pass
+#         e.g. `--backup-path /backup`
+COPY docker-entrypoint.sh /app/docker-entrypoint.sh
+ENTRYPOINT ["/bin/sh", "/app/docker-entrypoint.sh"]

@@ -17,6 +17,19 @@ import sys
 from pathlib import Path
 from typing import Optional
 
+# Honor an explicit JAX_PLATFORMS before any backend initializes: some
+# accelerator rigs install a sitecustomize that re-pins JAX to the
+# hardware plugin through the config API (which beats the env var), so a
+# child process asked to run on CPU would instead block on an
+# unavailable accelerator.  The config API also wins for us.
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:  # pragma: no cover - jax not installed / exotic rig
+        pass
+
 
 def _addr_host(addr: str) -> str:
     """Host part of a ``host:port`` address, handling bracketed IPv6

@@ -56,10 +56,25 @@ CREATE INDEX IF NOT EXISTS snapshots_by_client
 
 
 class ServerDB:
-    """server/src/db.rs equivalent (embedded)."""
+    """server/src/db.rs equivalent (embedded SQLite).
+
+    The reference runs the coordination schema on Postgres
+    (``server/src/db.rs:12-40``); here it is embedded.  Concurrency
+    envelope, documented deliberately: WAL mode gives concurrent readers
+    with a single writer, and every write the coordination plane makes
+    (client registration, storage-request rows, negotiation records) is a
+    sub-millisecond single-row statement at human backup cadence — orders
+    of magnitude under SQLite's write ceiling.  The seam for a
+    server-farm deployment is this class: it is the only component that
+    touches the database, so a Postgres-backed twin can replace it
+    without touching handlers.
+    """
 
     def __init__(self, path):
         self._db = sqlite3.connect(path, check_same_thread=False)
+        if path != ":memory:":
+            self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
         self._db.executescript(_SCHEMA)
         self._db.commit()
 

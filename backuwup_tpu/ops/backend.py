@@ -120,6 +120,40 @@ class CpuBackend(ChunkerBackend):
         return blake3_many(datas)
 
 
+class NativeBackend(ChunkerBackend):
+    """Host fast path: the C pipeline (``native/cdc_blake3.c``) via ctypes.
+
+    Same bit-exact manifests as :class:`CpuBackend` (tests pin C vs spec
+    oracle) at ~30x the numpy oracle's throughput — the engine's default
+    on hosts without an accelerator.  Raises
+    :class:`backuwup_tpu.native.NativeUnavailable` at construction when no
+    C toolchain/library is present; callers fall back to CpuBackend.
+    """
+
+    name = "native"
+
+    def __init__(self, params: Optional[CDCParams] = None):
+        from .. import native
+        self.params = params or CDCParams()
+        native.load()  # raises NativeUnavailable without a toolchain
+        self._native = native
+
+    def chunk(self, data):
+        return self._native.chunk_native(data, self.params)
+
+    def digest_many(self, datas):
+        return [self._native.blake3_native(bytes(d)) for d in datas]
+
+    def manifest_many(self, streams):
+        out = []
+        for data in streams:
+            chunks, digests = self._native.manifest_native(
+                bytes(data), self.params)
+            out.append([ChunkRef(offset=off, length=ln, hash=h)
+                        for (off, ln), h in zip(chunks, digests)])
+        return out
+
+
 class TpuBackend(ChunkerBackend):
     """Device-resident execution: ``manifest_many`` stages each batch into
     HBM once and runs scan -> cut -> HBM-to-HBM chunk gather -> batched
@@ -168,9 +202,19 @@ def _accelerator_attached() -> bool:
 
 def select_backend(prefer: Optional[str] = None,
                    params: Optional[CDCParams] = None) -> ChunkerBackend:
-    """``prefer`` in {"cpu", "tpu", None}; None = auto-detect."""
+    """``prefer`` in {"cpu", "native", "tpu", None}; None = auto-detect
+    (TPU if an accelerator is attached, else the native C pipeline, else
+    the numpy oracle)."""
     if prefer == "cpu":
         return CpuBackend(params)
+    if prefer == "native":
+        return NativeBackend(params)
     if prefer == "tpu":
         return TpuBackend(params)
-    return TpuBackend(params) if _accelerator_attached() else CpuBackend(params)
+    if _accelerator_attached():
+        return TpuBackend(params)
+    from .. import native
+    try:
+        return NativeBackend(params)
+    except native.NativeUnavailable:
+        return CpuBackend(params)

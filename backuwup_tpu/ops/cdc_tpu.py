@@ -299,6 +299,157 @@ def scan_words_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray,
     return jax.vmap(one)(ext_b, nv_b)
 
 
+def _parallel_select(pos_l, pos_s, n, *, min_size: int, desired_size: int,
+                     max_size: int, s_cap: int, l_cap: int, cut_cap: int,
+                     probe_iters: int = 6):
+    """FastCDC cut selection in O(log) depth instead of a sequential loop.
+
+    The greedy selection (``select_cuts``) is a chain walk: each cut is a
+    function of the previous cut only.  The walk is parallelized with the
+    classic pointer-jumping construction:
+
+    * ``F(c)`` — the next *candidate* cut after a chunk ending at loose
+      candidate ``c``, plus the count of forced (max-size) cuts emitted in
+      between — is computed for EVERY candidate at once.  Forced runs are
+      resolved in closed form: with no candidate in reach, the next start
+      that could possibly cut jumps straight past the whole candidate-free
+      gap (``steps = ceil((target-y)/max)``), so even an all-zeros stream
+      (zero candidates) resolves in one probe.  ``probe_iters`` bounds the
+      alignment retries; unresolved nodes flag the row for the oracle
+      fallback (adversarial interval patterns only).
+    * Doubling tables ``nxt_k = nxt_{k-1}[nxt_{k-1}]`` give the node and
+      emitted-cut count ``2^k`` hops ahead.
+    * Each output slot ``m`` independently walks the tables high-to-low
+      (take a ``2^k``-hop block iff its emitted count stays <= ``m``),
+      then reads its cut: a forced position (arithmetic) or the hop's
+      candidate/terminal cut.
+
+    Replaces a ``cut_cap``-iteration ``lax.while_loop`` whose per-step
+    latency dominated small-chunk configs (measured 389 ms of 481 ms for
+    64 KiB chunks on a 256 MiB segment).  Bit-identical to
+    :func:`backuwup_tpu.ops.cdc_cpu.select_cuts` (property-tested; bench
+    parity gate end-to-end).
+    """
+    m = jnp.int32(min_size)
+    d = jnp.int32(desired_size)
+    M = jnp.int32(max_size)
+    TERM = jnp.int32(l_cap)
+
+    def step_from(x):
+        """Candidate-window check for starts ``x``: (hit, cut position)."""
+        lo1 = x + (m - 1)
+        hi1 = jnp.minimum(x + (d - 2), n - 2)
+        i = jnp.searchsorted(pos_s, lo1, side="left")
+        e1 = pos_s[jnp.minimum(i, s_cap - 1)]
+        ok1 = (i < s_cap) & (e1 <= hi1)
+        lo2 = x + (d - 1)
+        hi2 = jnp.minimum(x + (M - 2), n - 2)
+        j = jnp.searchsorted(pos_l, lo2, side="left")
+        e2 = pos_l[jnp.minimum(j, l_cap - 1)]
+        ok2 = (j < l_cap) & (e2 <= hi2)
+        return ok1 | ok2, jnp.where(ok1, e1, e2)
+
+    def resolve(x0):
+        """F for starts ``x0``: (kind TERM/node-pos, forced count,
+        final cut pos, unresolved)."""
+        y = x0
+        jcnt = jnp.zeros_like(x0)
+        done = jnp.zeros(x0.shape, dtype=bool)
+        is_term = jnp.zeros(x0.shape, dtype=bool)
+        final = jnp.full_like(x0, -1)
+        for _ in range(probe_iters):
+            short = (n - y) <= m  # short tail -> single final chunk
+            hit, e = step_from(y)
+            at_eof = y >= n - M   # forced cut would land at n-1
+            now_term = short | (~hit & at_eof)
+            resolved = ~done & (short | hit | at_eof)
+            final = jnp.where(resolved,
+                              jnp.where(short, n - 1,
+                                        jnp.where(hit, e, n - 1)), final)
+            is_term = jnp.where(resolved, now_term, is_term)
+            # forced-EOF emits its n-1 cut as the hop's final cut, not as
+            # one of the arithmetic forced cuts
+            done = done | resolved
+            # closed-form jump over the candidate-free gap: earliest start
+            # that could see the next strict/loose candidate in-window
+            qs = pos_s[jnp.minimum(
+                jnp.searchsorted(pos_s, y + (m - 1), side="left"), s_cap - 1)]
+            ql = pos_l[jnp.minimum(
+                jnp.searchsorted(pos_l, y + (d - 1), side="left"), l_cap - 1)]
+            target = jnp.minimum(jnp.minimum(qs - (d - 2), ql - (M - 2)),
+                                 n - M)
+            steps = jnp.maximum(
+                (target - y + M - 1) // M, 1)
+            y = jnp.where(done, y, y + steps * M)
+            jcnt = jnp.where(done, jcnt, jcnt + steps)
+        return is_term, jcnt, final, ~done
+
+    # F for every candidate node (start = pos_l[c] + 1) and for START
+    starts = jnp.concatenate([pos_l + 1, jnp.zeros(1, dtype=pos_l.dtype)])
+    is_term, jcnt, final, unres = resolve(starts)
+    node_final = final[:l_cap]
+    node_term = is_term[:l_cap]
+    node_j = jcnt[:l_cap]
+    node_un = unres[:l_cap]
+    # next node index: the final cut is itself a loose candidate unless
+    # terminal (exact match by construction)
+    nxt0 = jnp.where(
+        node_term, TERM,
+        jnp.searchsorted(pos_l, node_final, side="left").astype(jnp.int32))
+    emit0 = node_j + 1  # j forced cuts + 1 candidate/terminal cut
+    # TERM self-loop emits nothing
+    nxt0 = jnp.concatenate([nxt0, TERM[None]])
+    emit0 = jnp.concatenate([emit0, jnp.zeros(1, jnp.int32)])
+    un0 = jnp.concatenate([node_un, jnp.zeros(1, dtype=bool)])
+
+    # 2^(levels-1) hops must cover the longest possible chain (cut_cap)
+    levels = max(1, cut_cap.bit_length() + 1)
+    nxts, emits, uns = [nxt0], [emit0], [un0]
+    for _ in range(levels - 1):
+        nk, ek, uk = nxts[-1], emits[-1], uns[-1]
+        nxts.append(nk[nk])
+        emits.append(ek + ek[nk])
+        uns.append(uk | uk[nk])
+
+    # hop 0: from START (virtual cut at -1, start 0)
+    h0_term = is_term[l_cap]
+    h0_j = jcnt[l_cap]
+    h0_final = final[l_cap]
+    h0_un = unres[l_cap]
+    b1 = jnp.where(
+        h0_term, TERM,
+        jnp.searchsorted(pos_l, h0_final, side="left").astype(jnp.int32))
+    h0_emit = h0_j + 1
+    total = h0_emit + emits[-1][b1]
+    row_unres = h0_un | uns[-1][b1]
+    n_cuts = jnp.where(n > 0, total, 0)
+
+    # per-slot table walk
+    mslot = jnp.arange(cut_cap, dtype=jnp.int32)
+    in_h0 = mslot < h0_emit
+    # hop-0 cuts: forced k*M-1 for slot k-1, then the resolved final
+    cut_h0 = jnp.where(mslot < h0_j, (mslot + 1) * M - 1, h0_final)
+    mrel = mslot - h0_emit
+    cur = jnp.full(cut_cap, 0, dtype=jnp.int32) + b1
+    acc = jnp.zeros(cut_cap, dtype=jnp.int32)
+    for k in range(levels - 1, -1, -1):
+        cand_acc = acc + emits[k][cur]
+        take = cand_acc <= mrel
+        cur = jnp.where(take, nxts[k][cur], cur)
+        acc = jnp.where(take, cand_acc, acc)
+    # the hop from `cur` covers slot mrel: r-th of its fcount forced cuts,
+    # or its final candidate/terminal cut
+    r = mrel - acc
+    cur_safe = jnp.minimum(cur, TERM)
+    x_cur = pos_l[jnp.minimum(cur_safe, l_cap - 1)] + 1
+    fcount = jnp.maximum(emit0[cur_safe] - 1, 0)
+    final_cur = node_final[jnp.minimum(cur_safe, l_cap - 1)]
+    cut_m = jnp.where(r < fcount, x_cur + (r + 1) * M - 1, final_cur)
+    cuts = jnp.where(in_h0, cut_h0, cut_m)
+    cuts = jnp.where(mslot < n_cuts, cuts, -1)
+    return n_cuts, cuts, row_unres
+
+
 @functools.partial(jax.jit, static_argnames=(
     "min_size", "desired_size", "max_size", "mask_s", "mask_l",
     "s_cap", "l_cap", "cut_cap", "fused"))
@@ -406,38 +557,10 @@ def scan_select_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
 
     def one(n, words_l, words_s):
         pos_l, pos_s, ovf = compact_words(words_l, words_s)
-        overflow = ovf.astype(jnp.int32)
-
-        def cond(st):
-            s, k, _ = st
-            return s < n
-
-        def body(st):
-            s, k, cuts = st
-            # window 1: [min, desired) with the strict mask
-            lo = s + jnp.int32(min_size - 1)
-            hi = jnp.minimum(s + jnp.int32(desired_size - 2), n - 2)
-            i = jnp.searchsorted(pos_s, lo, side="left")
-            e1 = pos_s[jnp.minimum(i, s_cap - 1)]
-            ok1 = (i < s_cap) & (e1 <= hi)
-            # window 2: [desired, max) with the loose mask
-            lo2 = s + jnp.int32(desired_size - 1)
-            hi2 = jnp.minimum(s + jnp.int32(max_size - 2), n - 2)
-            j = jnp.searchsorted(pos_l, lo2, side="left")
-            e2 = pos_l[jnp.minimum(j, l_cap - 1)]
-            ok2 = (j < l_cap) & (e2 <= hi2)
-            # forced cut at max, or EOF
-            e = jnp.where(ok1, e1, jnp.where(
-                ok2, e2, jnp.minimum(s + jnp.int32(max_size - 1), n - 1)))
-            # short tail: everything left is one final chunk
-            e = jnp.where(n - s <= jnp.int32(min_size), n - 1, e)
-            cuts = cuts.at[k].set(e)
-            return e + 1, k + 1, cuts
-
-        s0 = jnp.int32(0)
-        k0 = jnp.int32(0)
-        cuts0 = jnp.full(cut_cap, -1, dtype=jnp.int32)
-        _, n_cuts, cuts = jax.lax.while_loop(cond, body, (s0, k0, cuts0))
+        n_cuts, cuts, unres = _parallel_select(
+            pos_l, pos_s, n, min_size=min_size, desired_size=desired_size,
+            max_size=max_size, s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap)
+        overflow = (ovf | unres).astype(jnp.int32)
         return jnp.concatenate([overflow[None], n_cuts[None], cuts])
 
     nv_i = nv_b.astype(jnp.int32)

@@ -121,6 +121,23 @@ class ShardedDedupIndex:
             pending = pending[np.asarray(lost) == LOST_RACE]
         return out
 
+    def insert_device(self, q_dev, v_dev):
+        """Device-resident insert: dispatches and returns
+        ``(found_dev, lost_dev)`` WITHOUT any host synchronization — races
+        retry on device, so callers batch many inserts back to back and
+        validate the (async-downloaded) ``lost`` vectors once at the end
+        (`lost != 0` after the in-device retries means the table needs
+        resizing; see :meth:`insert`).
+
+        This is the path the backup engine's device-dedup uses: digests
+        land in HBM from the digest stage and never round-trip the host
+        before probing — the analog of the reference's in-memory
+        ``blob_index.rs:143-148`` lookup, at batch granularity.
+        """
+        self.keys, self.values, found, lost = self._fn(True)(
+            self.keys, self.values, q_dev, v_dev)
+        return found, lost
+
     def grown(self, new_capacity: int) -> "ShardedDedupIndex":
         """Capacity-doubled (or more) copy with the resident keys
         re-hashed ON DEVICE — shard routing depends only on the hash
@@ -214,34 +231,64 @@ def _build_probe_fn(mesh: Mesh, axis: str, capacity: int, max_probes: int,
         mine = owner == me
         # non-owned queries become empty (probe nothing, contribute 0)
         q_masked = jnp.where(mine[:, None], allq, jnp.uint32(0))
-        found, slot, done = local_probe(keys, values, q_masked)
-        found = jnp.where(mine, found, jnp.uint32(0))
         if insert:
             allv = jax.lax.all_gather(ins_vals[0][0], axis).reshape(-1)
-            is_new = (mine & (found == 0) & (slot >= 0)
-                      & ~jnp.all(allq == 0, axis=1))
-            # Scatter new keys into the local shard.  Two *different* new
-            # keys landing on the same empty slot in one batch: last write
-            # wins.  The scatter is verified below and losers are reported
-            # so the host retries them (they then probe past this slot).
-            tgt = jnp.where(is_new, slot, capacity)  # capacity = dropped
-            upd_keys = keys.at[tgt].set(
-                jnp.where(is_new[:, None], allq, jnp.uint32(0)), mode="drop")
-            upd_vals = values.at[tgt].set(
-                jnp.where(is_new, allv, jnp.uint32(0)), mode="drop")
-            stored_key = upd_keys[jnp.clip(slot, 0, capacity - 1)]
-            # done==False after max_probes means neither a hit nor an empty
-            # slot was seen: the key was NOT inserted.  Report it distinctly
-            # so the host can resize instead of silently dropping the key.
-            exhausted = mine & ~done
-            lost = ((is_new & ~jnp.all(stored_key == allq, axis=1)
-                     ).astype(jnp.uint32) * jnp.uint32(LOST_RACE)
-                    + exhausted.astype(jnp.uint32) * jnp.uint32(LOST_EXHAUSTED))
+            empty_q = jnp.all(allq == 0, axis=1)
+
+            def attempt(keys, values, active):
+                """One probe+scatter round over the ``active`` queries.
+
+                Two *different* new keys landing on the same empty slot:
+                last write wins; losers are detected by re-reading the
+                slot and retried (they then probe past it).
+                """
+                qa = jnp.where(active[:, None], allq, jnp.uint32(0))
+                found, slot, done = local_probe(keys, values, qa)
+                is_new = active & (found == 0) & (slot >= 0) & ~empty_q
+                tgt = jnp.where(is_new, slot, capacity)  # capacity=dropped
+                upd_keys = keys.at[tgt].set(
+                    jnp.where(is_new[:, None], allq, jnp.uint32(0)),
+                    mode="drop")
+                upd_vals = values.at[tgt].set(
+                    jnp.where(is_new, allv, jnp.uint32(0)), mode="drop")
+                stored = upd_keys[jnp.clip(slot, 0, capacity - 1)]
+                race = is_new & ~jnp.all(stored == allq, axis=1)
+                # done==False after max_probes means neither a hit nor an
+                # empty slot was seen: the key was NOT inserted.  Reported
+                # distinctly so the host resizes instead of dropping keys.
+                exhausted = active & ~done
+                return upd_keys, upd_vals, found, race, exhausted
+
+            keys, values, found, race, exh = attempt(keys, values, mine)
+            found = jnp.where(mine, found, jnp.uint32(0))
+
+            # retry races ON DEVICE (shard-local, collective-free, so
+            # divergent trip counts across shards are fine); each round
+            # strictly shrinks the race set — one winner per contested
+            # slot — large batches at moderate load factors start with
+            # thousands of birthday collisions (measured ~1.9k for a
+            # 250k-key batch at 12% load), so the cap is generous; any
+            # residual goes back to the host loop as before
+            def cond(st):
+                _k, _v, race, _e, r = st
+                return jnp.any(race) & (r < 10)
+
+            def body(st):
+                keys, values, race, exh, r = st
+                keys, values, _f, race2, exh2 = attempt(keys, values, race)
+                return keys, values, race2, exh | exh2, r + 1
+
+            keys, values, race, exh, _ = jax.lax.while_loop(
+                cond, body, (keys, values, race, exh, jnp.int32(0)))
+            lost = (race.astype(jnp.uint32) * jnp.uint32(LOST_RACE)
+                    + exh.astype(jnp.uint32) * jnp.uint32(LOST_EXHAUSTED))
             found_all = jax.lax.psum(found, axis)
             lost_all = jax.lax.psum(lost, axis)
             myq = found_all.reshape(n_dev, -1)[me]
             mylost = lost_all.reshape(n_dev, -1)[me]
-            return upd_keys[None], upd_vals[None], myq[None], mylost[None]
+            return keys[None], values[None], myq[None], mylost[None]
+        found, slot, done = local_probe(keys, values, q_masked)
+        found = jnp.where(mine, found, jnp.uint32(0))
         found_all = jax.lax.psum(found, axis)
         myq = found_all.reshape(n_dev, -1)[me]
         return myq[None]

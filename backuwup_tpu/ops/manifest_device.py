@@ -111,10 +111,13 @@ def class_caps(params: CDCParams, total_bytes: int,
                n_rows: int) -> Tuple[int, ...]:
     """Per-class chunk-slot capacities for one batch shape.
 
-    Expectation + 3 sigma (binomial) + slack; class 0 additionally holds
-    every row's short tail.  Digest compute scales with cap x class span,
-    so slack is deliberately tight; an overflow is detected on device and
-    the batch re-runs on the host-tiled path (bit-exact either way).
+    Expectation + 0.75 sigma (binomial) per class — deliberately tight,
+    because digest compute scales with cap x class span and the cascade
+    hands per-class excess to the next span class; only total-count
+    fluctuation reaches the terminus (which carries the real slack).
+    Class 0 additionally holds every row's short tail.  A cascade
+    overflow is detected on device and the batch re-runs on the
+    host-tiled path (bit-exact either way).
     """
     mean_len, fracs = _length_histogram(params)
     expect_total = total_bytes / max(mean_len, 1.0)
@@ -135,12 +138,14 @@ def class_caps(params: CDCParams, total_bytes: int,
 
 @functools.partial(jax.jit, static_argnames=(
     "min_size", "desired_size", "max_size", "mask_s", "mask_l",
-    "s_cap", "l_cap", "cut_cap", "fused", "classes", "caps"))
+    "s_cap", "l_cap", "cut_cap", "fused", "classes", "caps",
+    "pallas_digest"))
 def scan_digest_batch(buf_d: jnp.ndarray, nv_b: jnp.ndarray, *,
                       min_size: int, desired_size: int, max_size: int,
                       mask_s: int, mask_l: int, s_cap: int, l_cap: int,
                       cut_cap: int, fused: bool,
-                      classes: Tuple[int, ...], caps: Tuple[int, ...]):
+                      classes: Tuple[int, ...], caps: Tuple[int, ...],
+                      pallas_digest: bool = False):
     """One resident ``(B, _HALO+P)`` batch -> (packed cuts, digests, ovf).
 
     Everything stays on device: ``packed`` is ``scan_select_batch``'s
@@ -206,7 +211,7 @@ def scan_digest_batch(buf_d: jnp.ndarray, nv_b: jnp.ndarray, *,
             return jax.lax.dynamic_slice(flat, (off,), (span,))
 
         tile = jax.vmap(one)(o)
-        cv = digest_padded(tile, ln, L=Lc)  # (cap, 8)
+        cv = digest_padded(tile, ln, L=Lc, pallas=pallas_digest)  # (cap, 8)
         acc = acc.at[idx].set(cv, mode="drop")
     ovf = jnp.sum(carry.astype(jnp.int32))[None]  # terminus overflow only
     return packed, acc, ovf

@@ -192,15 +192,24 @@ class DevicePipeline:
         self.l_bucket = l_bucket
         self.b_bucket = b_bucket
         self._nv_cache: dict = {}
+        from .blake3_tpu import pallas_digest_available
         from .scan_fused import fused_scan_available
         self.fused = fused_scan_available()
+        self.pallas_digest = pallas_digest_available()
 
     # --- scan + select (device) -------------------------------------------
 
     def _caps(self, padded: int) -> Tuple[int, int, int]:
-        """(s_cap, l_cap, cut_cap) for a padded row length."""
+        """(s_cap, l_cap, cut_cap) for a padded row length.
+
+        Candidate capacity is 4x the expectation: every gather/search in
+        the parallel cut selection scales with ``l_cap``, and 16x slack
+        measured ~3x slower end-to-end.  Density is binomial
+        (sigma/mu ~= 1/sqrt(mu)), so 4x overflows only on adversarial
+        gear-aligned data — which already needs the oracle fallback.
+        """
         p = self.params
-        l_cap = max(512, _round_up(16 * max(1, padded >> p.mask_l_bits), 512))
+        l_cap = max(512, _round_up(4 * max(1, padded >> p.mask_l_bits), 512))
         cut_cap = padded // p.min_size + 1
         return l_cap, l_cap, cut_cap
 
@@ -424,7 +433,8 @@ class DevicePipeline:
                         max_size=p.max_size, mask_s=p.mask_s,
                         mask_l=p.mask_l, s_cap=s_cap, l_cap=l_cap,
                         cut_cap=cut_cap, fused=self.fused,
-                        classes=classes, caps=caps)
+                        classes=classes, caps=caps,
+                        pallas_digest=self.pallas_digest)
                 for a in (packed, acc, ovf):
                     _async_to_host(a)
                 pending.append((buf_d, nv, cut_cap, packed, acc, ovf))

@@ -9,20 +9,23 @@ dedup output):
 * TPU path: corpus segments are synthesized **on device** with the JAX PRNG
   (the dev rig's host<->device relay tunnel is ~6 MiB/s, three orders below
   real PCIe/DMA, so streaming host bytes would measure the tunnel, not the
-  kernels).  Each segment runs the full resident pipeline: gear scan ->
-  sparse candidates -> host cut selection -> on-device chunk gather ->
-  batched BLAKE3.
+  kernels).  The timed loop is the production zero-round-trip driver
+  (``DevicePipeline.manifest_segments_device``): Mosaic strip scan ->
+  on-device parallel cut selection -> class-bucketed gather -> Pallas
+  BLAKE3, with only async downloads of cuts+digests.
 * CPU baseline: the native C implementation (``native/cdc_blake3.c``) of the
   identical pipeline on ONE host thread — the honest stand-in for the
   reference's fastcdc+blake3 crates; parity vs the spec oracle is asserted
-  by tests/test_native.py and re-checked here before timing.  The numpy
-  oracle's throughput is logged as a secondary line only.
+  by tests/test_native.py and re-checked here before timing.  Best of 3
+  runs (the shared dev host carries background load).
 * Parity gate: an 8 MiB corpus is pushed through BOTH paths bit-for-bit;
   chunk boundaries and digests must match exactly or the benchmark reports
   failure — speed without identical dedup output is meaningless.
 
-Environment knobs: BENCH_SEGMENTS (default 4), BENCH_SEGMENT_MIB (default
-128), BENCH_CPU_MIB (default 64).
+Scale: the headline corpus is BENCH_GIB GiB (default 10, BASELINE.md:37)
+streamed as 256 MiB segments from a rotating pool of 8 device-resident
+random segments.  Environment knobs: BENCH_GIB, BENCH_SEGMENT_MIB,
+BENCH_CPU_MIB, BENCH_CONFIGS=0.
 """
 
 from __future__ import annotations
@@ -51,14 +54,16 @@ def main() -> None:
     from backuwup_tpu.ops.gear import CDCParams
     from backuwup_tpu.ops.pipeline import DevicePipeline
 
-    segments = int(os.environ.get("BENCH_SEGMENTS", "3"))
+    total_gib = float(os.environ.get("BENCH_GIB", "10"))
     seg_mib = int(os.environ.get("BENCH_SEGMENT_MIB", "256"))
     cpu_mib = int(os.environ.get("BENCH_CPU_MIB", "64"))
     params = CDCParams()  # production 256KiB/1MiB/3MiB
     pipeline = DevicePipeline(params)
     seg_bytes = seg_mib * (1 << 20)
+    segments = max(2, int(total_gib * 1024) // seg_mib)
 
-    log(f"devices: {jax.devices()}")
+    log(f"devices: {jax.devices()}  fused={pipeline.fused} "
+        f"pallas_digest={pipeline.pallas_digest}")
 
     # --- parity gate -------------------------------------------------------
     rng = np.random.default_rng(1234)
@@ -70,9 +75,9 @@ def main() -> None:
     cpu_digests = Blake3Numpy().digest_batch(
         [parity_bytes[o:o + l] for o, l in cpu_chunks])
     ext = np.concatenate([np.zeros(_HALO, dtype=np.uint8), parity])
-    (tpu_chunks, tpu_digests), = pipeline.manifest_resident_batch(
-        jnp.asarray(ext.reshape(1, -1)),
-        np.full(1, len(parity_bytes), dtype=np.int32))
+    (tpu_chunks, tpu_digests), = next(iter(pipeline.manifest_segments_device(
+        [(jnp.asarray(ext.reshape(1, -1)),
+          np.full(1, len(parity_bytes), dtype=np.int32))])))
     tpu_digest_bytes = [bytes(d) for d in tpu_digests]
     if tpu_chunks != cpu_chunks or tpu_digest_bytes != cpu_digests:
         print(json.dumps({"metric": "chunk+hash parity FAILED", "value": 0.0,
@@ -81,13 +86,7 @@ def main() -> None:
     dedup = len(set(cpu_digests)) / len(cpu_digests)
     log(f"parity OK: {len(cpu_chunks)} chunks, unique-ratio {dedup:.3f}")
 
-    # --- TPU timing: pre-synthesized resident corpus, pipelined ------------
-    # Times pipeline.manifest_segments — the pipelined driver over the exact
-    # device core the engine's backup path runs per batch.  The corpus is
-    # synthesized into HBM up front (it would arrive by DMA in a real rig;
-    # here the relay tunnel would otherwise be the measurement), then the
-    # timed loop overlaps scan+select, cut download, and digest across
-    # segments.
+    # --- TPU timing: sustained streaming over the 10 GiB corpus ------------
     key = jax.random.PRNGKey(0)
     row = _HALO + seg_bytes
     nv = np.full(1, seg_bytes, dtype=np.int32)
@@ -98,44 +97,37 @@ def main() -> None:
         return jnp.concatenate([jnp.zeros(_HALO, dtype=jnp.uint8), seg]
                                ).reshape(1, row)
 
-    # warm: compile the closed digest tile universe (B in {8,32,128} x the
-    # production L buckets) plus the scan program, so the timed loop can
-    # never hit a 20-40s XLA compile regardless of chunk-count jitter;
-    # everything lands in the persistent cache for future runs
-    from backuwup_tpu.ops.pipeline import _gather_digest
-
-    span_max = pipeline.l_bucket * 1024
-    # the flat buffer's shape is part of the compiled signature: warm with
-    # the exact length the timed segments produce (1 row + gather slack)
-    flat_w = jnp.zeros(row + span_max, dtype=jnp.uint8)
-    meta_w = jnp.zeros((3, 256), dtype=jnp.int32)
-    for L in (256, 512, 1024, 2048, 3072):
-        for B in (8, 32, 128):
-            acc_w = jnp.zeros((256, 8), dtype=jnp.uint32)
-            _gather_digest(flat_w, meta_w, meta_w[2, 0], acc_w, B=B, L=L)
-    for _ in range(2):
+    # pool of 8 distinct resident segments cycled through the stream (the
+    # whole corpus cannot live in HBM at once; per-segment state is nil)
+    pool = []
+    for _ in range(min(8, segments)):
         key, sub = jax.random.split(key)
-        pipeline.manifest_resident_batch(synth(sub), nv, strict_overflow=True)
+        pool.append((synth(sub), nv))
+    jax.block_until_ready([b for b, _ in pool])
 
-    corpus = []
-    for _ in range(segments):
-        key, sub = jax.random.split(key)
-        corpus.append((synth(sub), nv))
-    jax.block_until_ready([b for b, _ in corpus])
+    # warm every compiled shape out of the timed loop
+    list(pipeline.manifest_segments_device(pool[:2], strict_overflow=True))
+
+    def corpus():
+        for i in range(segments):
+            yield pool[i % len(pool)]
 
     t0 = time.time()
-    results = list(pipeline.manifest_segments(corpus, strict_overflow=True))
+    total_chunks = 0
+    for results in pipeline.manifest_segments_device(
+            corpus(), strict_overflow=True):
+        for chunks, _dig in results:
+            total_chunks += len(chunks)
     tpu_s = time.time() - t0
-    total_chunks = sum(len(chunks) for (chunks, _), in results)
     tpu_mibs = segments * seg_mib / tpu_s
-    log(f"tpu: {segments}x{seg_mib} MiB in {tpu_s:.2f}s = {tpu_mibs:.1f} MiB/s"
-        f" ({total_chunks} chunks)")
+    log(f"tpu: {segments}x{seg_mib} MiB ({segments*seg_mib/1024:.1f} GiB) "
+        f"in {tpu_s:.2f}s = {tpu_mibs:.1f} MiB/s ({total_chunks} chunks)")
 
-    # --- CPU baseline: native C pipeline, single thread --------------------
+    # --- CPU baseline: native C pipeline, single thread, best of 3 ---------
     from backuwup_tpu import native
 
     host = rng.integers(0, 256, cpu_mib << 20, dtype=np.uint8).tobytes()
-    baseline_kind = "native C fastcdc+blake3 pipeline, 1 host thread"
+    baseline_kind = "native C fastcdc-class+blake3 pipeline, 1 host thread"
     try:
         nat_chunks, nat_digests = native.manifest_native(parity_bytes, params)
         if nat_chunks != cpu_chunks or nat_digests != cpu_digests:
@@ -143,12 +135,11 @@ def main() -> None:
                               "value": 0.0, "unit": "MiB/s",
                               "vs_baseline": 0.0}))
             return
-        t0 = time.time()
-        native.manifest_native(host, params)
-        cpu_s = time.time() - t0
+        cpu_s = min(_timed(native.manifest_native, host, params)
+                    for _ in range(3))
         cpu_mibs = cpu_mib / cpu_s
         log(f"cpu-native: {cpu_mib} MiB in {cpu_s:.2f}s = {cpu_mibs:.1f}"
-            " MiB/s (single thread)")
+            " MiB/s (single thread, best of 3)")
     except native.NativeUnavailable as e:
         # no C compiler on this host: fall back to the numpy oracle as the
         # (much slower) baseline rather than crashing the JSON contract
@@ -160,7 +151,7 @@ def main() -> None:
         cpu_s = time.time() - t0
         cpu_mibs = cpu_mib / cpu_s
 
-    # --- BASELINE configs #2-#5 -------------------------------------------
+    # --- BASELINE configs #2-#6 -------------------------------------------
     configs = {}
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
         import bench_configs
@@ -173,11 +164,19 @@ def main() -> None:
         "unit": "MiB/s",
         "vs_baseline": round(tpu_mibs / cpu_mibs, 2),
         "baseline": f"{baseline_kind} ({cpu_mibs:.1f} MiB/s)",
+        "corpus_gib": round(segments * seg_mib / 1024, 2),
+        "wall_s": round(tpu_s, 2),
         "configs": configs,
         "note": "corpus synthesized on-device (host<->device relay tunnel "
                 "~6 MiB/s would measure the tunnel, not the kernels); "
                 "parity vs CPU oracle gated per config",
     }))
+
+
+def _timed(fn, *args):
+    t0 = time.time()
+    fn(*args)
+    return time.time() - t0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,11 @@
+#!/bin/sh
+# Container entry: role comes from BKW_ROLE (server|client); extra args
+# pass through to `python -m backuwup_tpu <role>`.
+set -e
+if [ "${BKW_ROLE:-server}" = "server" ]; then
+    exec python -m backuwup_tpu server \
+        --bind "${SERVER_BIND:-0.0.0.0:9999}" \
+        --db "${SERVER_DB:-/data/server.db}" "$@"
+else
+    exec python -m backuwup_tpu client "$@"
+fi

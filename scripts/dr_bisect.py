@@ -1,0 +1,38 @@
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from backuwup_tpu.ops.dedup_index import ShardedDedupIndex
+
+mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+t0 = time.time()
+big = ShardedDedupIndex.create(mesh, capacity=1 << 18)
+print("create", time.time() - t0, flush=True)
+batch = 250_000
+vals = jnp.ones((8, batch // 8), dtype=jnp.uint32)
+key = jax.random.PRNGKey(99)
+for i in range(2):
+    key, sub = jax.random.split(key)
+    t0 = time.time()
+    q = jax.device_put(
+        jax.random.bits(sub, (batch, 4), dtype=jnp.uint32
+                        ).reshape(8, batch // 8, 4),
+        NamedSharding(mesh, P("data")))
+    jax.block_until_ready(q)
+    print("synth", time.time() - t0, flush=True)
+    t0 = time.time()
+    f, lo = big.insert_device(q, vals)
+    jax.block_until_ready((f, lo))
+    print("insert", i, time.time() - t0, "lost",
+          int(np.asarray(lo).sum()), flush=True)

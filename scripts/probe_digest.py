@@ -1,0 +1,68 @@
+"""Isolate the digest-section cost of scan_digest_batch."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from scripts.devtime import dev_time
+
+
+def main():
+    from backuwup_tpu.utils.jaxcache import enable_compilation_cache
+    enable_compilation_cache()
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from backuwup_tpu.ops.blake3_tpu import digest_padded
+    from backuwup_tpu.ops.cdc_tpu import _HALO, scan_select_batch
+    from backuwup_tpu.ops.gear import CDCParams
+    from backuwup_tpu.ops.manifest_device import (class_caps,
+                                                  class_leaf_sizes,
+                                                  scan_digest_batch)
+    from backuwup_tpu.ops.pipeline import DevicePipeline
+
+    # standalone digest_padded: 256 chunks x 1 MiB resident tile
+    key = jax.random.PRNGKey(1)
+    for B, L in ((256, 1024), (248, 1280), (128, 2048)):
+        tile = jax.random.randint(key, (B, L * 1024), 0, 256, dtype=jnp.uint8)
+        lens = jnp.full(B, L * 1024 - 7, dtype=jnp.int32)
+        jax.block_until_ready(tile)
+        for pallas in (False, True):
+            fn = jax.jit(functools.partial(digest_padded, L=L, pallas=pallas))
+            dt = dev_time(fn, tile, lens, n=10)
+            mib = B * L / 1024
+            print(f"digest_padded B={B} L={L} pallas={pallas}: "
+                  f"{dt*1e3:.1f} ms ({mib/max(dt,1e-9)/1024:.2f} GiB/s)",
+                  flush=True)
+
+    # full manifest with XLA vs pallas digest
+    P = 256 << 20
+    params = CDCParams()
+    pipe = DevicePipeline(params)
+    buf = jnp.concatenate(
+        [jnp.zeros(_HALO, dtype=jnp.uint8),
+         jax.random.randint(key, (P,), 0, 256, dtype=jnp.uint8)]
+    ).reshape(1, _HALO + P)
+    nv = jnp.asarray(np.full(1, P, dtype=np.int32))
+    s_cap, l_cap, cut_cap = pipe._caps(P)
+    classes = class_leaf_sizes(params)
+    caps = class_caps(params, P, 1)
+    base = dict(min_size=params.min_size, desired_size=params.desired_size,
+                max_size=params.max_size, mask_s=params.mask_s,
+                mask_l=params.mask_l, s_cap=s_cap, l_cap=l_cap,
+                cut_cap=cut_cap, fused=True)
+    for pallas in (False, True):
+        fn = jax.jit(functools.partial(scan_digest_batch, classes=classes,
+                                       caps=caps, pallas_digest=pallas,
+                                       **base))
+        dt = dev_time(fn, buf, nv, n=10)
+        print(f"scan_digest_batch pallas={pallas}: {dt*1e3:.1f} ms "
+              f"= {256/dt:.0f} MiB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

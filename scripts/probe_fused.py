@@ -23,7 +23,6 @@ def main():
     for P in (64 * 1024, 1 << 20, 16 << 20):
         ext = rng.integers(0, 256, (2, 31 + P), dtype=np.uint8)
         nv = np.array([P, P - 12345], dtype=np.int32)
-        mask_s, mask_l = 0xFFF00000 & 0xFFFFFFFF, 0xFF800000
         mask_s = (0xFFFFFFFF << (32 - 22)) & 0xFFFFFFFF
         mask_l = (0xFFFFFFFF << (32 - 18)) & 0xFFFFFFFF
         wl, ws = fused_candidate_words(jnp.asarray(ext), jnp.asarray(nv),

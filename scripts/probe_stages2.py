@@ -1,0 +1,79 @@
+"""Stage split after hierarchical compaction (honest chained timing)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from scripts.devtime import dev_time
+
+
+def main():
+    from backuwup_tpu.utils.jaxcache import enable_compilation_cache
+    enable_compilation_cache()
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from backuwup_tpu.ops.cdc_tpu import _HALO, scan_select_batch
+    from backuwup_tpu.ops.gear import CDCParams
+    from backuwup_tpu.ops.manifest_device import (class_caps,
+                                                  class_leaf_sizes,
+                                                  scan_digest_batch)
+    from backuwup_tpu.ops.blake3_tpu import pallas_digest_available
+    from backuwup_tpu.ops.pipeline import DevicePipeline
+    from backuwup_tpu.ops.scan_fused import fused_candidate_words
+
+    pdig = pallas_digest_available()
+    print("pallas digest:", pdig)
+
+    P = 256 << 20
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def synth(key):
+        seg = jax.random.randint(key, (P,), 0, 256, dtype=jnp.uint8)
+        return jnp.concatenate([jnp.zeros(_HALO, dtype=jnp.uint8), seg]
+                               ).reshape(1, _HALO + P)
+
+    buf = synth(key)
+    nv = jnp.asarray(np.full(1, P, dtype=np.int32))
+
+    for tag, params in (("1MiB", CDCParams()),
+                        ("64KiB", CDCParams.from_desired(64 << 10))):
+        pipe = DevicePipeline(params)
+        s_cap, l_cap, cut_cap = pipe._caps(P)
+        fw = jax.jit(functools.partial(
+            fused_candidate_words, mask_s=params.mask_s,
+            mask_l=params.mask_l))
+        t_scan = dev_time(fw, buf, nv)
+        print(f"[{tag}] scan={t_scan*1e3:.1f}ms", flush=True)
+        fn = jax.jit(functools.partial(
+            scan_select_batch, min_size=params.min_size,
+            desired_size=params.desired_size, max_size=params.max_size,
+            mask_s=params.mask_s, mask_l=params.mask_l,
+            s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap, fused=True))
+        t_ss = dev_time(fn, buf, nv)
+        print(f"[{tag}] scan+select={t_ss*1e3:.1f}ms "
+              f"(compact+select={1e3*(t_ss-t_scan):.1f})", flush=True)
+        classes = class_leaf_sizes(params)
+        caps = class_caps(params, P, 1)
+        full = jax.jit(functools.partial(
+            scan_digest_batch, min_size=params.min_size,
+            desired_size=params.desired_size, max_size=params.max_size,
+            mask_s=params.mask_s, mask_l=params.mask_l,
+            s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap, fused=True,
+            classes=classes, caps=caps, pallas_digest=pdig))
+        t_full = dev_time(full, buf, nv, n=10)
+        print(f"[{tag}] scan={t_scan*1e3:.1f}ms  "
+              f"scan+select={t_ss*1e3:.1f}ms "
+              f"(compact+select={1e3*(t_ss-t_scan):.1f})  "
+              f"full manifest={t_full*1e3:.1f}ms "
+              f"(digest~={1e3*(t_full-t_ss):.1f})  "
+              f"=> {256/t_full:.0f} MiB/s device-side")
+
+
+if __name__ == "__main__":
+    main()

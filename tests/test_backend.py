@@ -72,3 +72,19 @@ def test_manifest_stream_matches_manifest(backends, rng=random.Random(7)):
 def test_select_backend_policy():
     assert select_backend("cpu").name == "cpu"
     assert select_backend("tpu").name == "tpu"
+
+
+def test_native_backend_matches_cpu():
+    pytest.importorskip("ctypes")
+    from backuwup_tpu.ops.backend import NativeBackend
+    from backuwup_tpu.native import NativeUnavailable
+    try:
+        nat = NativeBackend(PARAMS)
+    except NativeUnavailable:
+        pytest.skip("native toolchain unavailable")
+    cpu = CpuBackend(PARAMS)
+    data = random.Random(11).randbytes(200_000)
+    got = nat.manifest_many([data, b"", data[:100]])
+    want = cpu.manifest_many([data, b"", data[:100]])
+    assert [[(r.offset, r.length, r.hash) for r in refs] for refs in got] \
+        == [[(r.offset, r.length, r.hash) for r in refs] for refs in want]

@@ -1,0 +1,81 @@
+"""Parallel (jump-table) cut selection must equal the sequential oracle.
+
+Stress surface: forced-cut runs (zero/constant regions have no gear
+candidates, so every cut is forced at max_size), alignment-dependent
+probe retries (periodic data), candidate-dense and candidate-free mixes,
+short tails, and multiple parameter sets including the 64 KiB profile
+whose sequential while_loop this replaces.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from backuwup_tpu.ops import cdc_cpu
+from backuwup_tpu.ops.cdc_tpu import _HALO, scan_select_batch
+from backuwup_tpu.ops.gear import CDCParams
+
+PARAMS = [
+    CDCParams.from_desired(4096),
+    CDCParams.from_desired(16384),
+    CDCParams(min_size=1024, desired_size=4096, max_size=6144,
+              mask_s_bits=14, mask_l_bits=10),
+]
+
+
+def _run_device(data: bytes, params: CDCParams, P: int):
+    buf = np.zeros((1, _HALO + P), dtype=np.uint8)
+    buf[0, _HALO:_HALO + len(data)] = np.frombuffer(data, dtype=np.uint8)
+    l_cap = max(512, ((16 * max(1, P >> params.mask_l_bits)) + 511)
+                // 512 * 512)
+    cut_cap = P // params.min_size + 1
+    packed = scan_select_batch(
+        jnp.asarray(buf), jnp.asarray(np.array([len(data)], np.int32)),
+        min_size=params.min_size, desired_size=params.desired_size,
+        max_size=params.max_size, mask_s=params.mask_s,
+        mask_l=params.mask_l, s_cap=l_cap, l_cap=l_cap, cut_cap=cut_cap,
+        fused=False)
+    row = np.asarray(packed)[0]
+    assert row[0] == 0, "unexpected overflow/unresolved on test data"
+    n_cuts = int(row[1])
+    ends = row[2:2 + n_cuts].astype(np.int64)
+    offs = np.concatenate([[0], ends[:-1] + 1])
+    return list(zip(offs.tolist(), (ends - offs + 1).tolist()))
+
+
+def _corpora(rng, n):
+    yield "random", rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+    yield "zeros", b"\0" * n
+    yield "const", b"\x5a" * n
+    # periodic: candidate positions repeat with the period, the
+    # alignment-retry path of the closed-form forced jump
+    pat = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    yield "periodic", (pat * (n // len(pat) + 1))[:n]
+    # half zeros then random: a long candidate-free gap mid-stream
+    half = rng.integers(0, 256, n - n // 2, dtype=np.uint8).tobytes()
+    yield "gap", b"\0" * (n // 2) + half
+    # random with zero windows sprinkled in
+    mixed = bytearray(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+    for off in range(0, n - 8192, 37 * 1024):
+        mixed[off:off + 8192] = b"\0" * 8192
+    yield "sprinkled", bytes(mixed)
+
+
+@pytest.mark.parametrize("params", PARAMS)
+def test_parallel_select_matches_oracle(params):
+    rng = np.random.default_rng(99)
+    P = 1 << 20
+    for tag, data in _corpora(rng, P):
+        got = _run_device(data, params, P)
+        want = cdc_cpu.chunk_stream(data, params)
+        assert got == want, f"{tag} @ desired={params.desired_size}"
+
+
+@pytest.mark.parametrize("n", [0, 1, 1023, 1024, 1025, 4095, 4096, 65535])
+def test_parallel_select_sizes(n):
+    params = CDCParams.from_desired(4096)
+    data = np.random.default_rng(n or 5).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+    got = _run_device(data, params, 65536)
+    assert got == cdc_cpu.chunk_stream(data, params)
