@@ -1,0 +1,12 @@
+#!/bin/sh
+# Poll the axon TPU until a trivial op completes; log recovery time.
+while true; do
+    if timeout 25 python -c "
+import jax, numpy as np, jax.numpy as jnp
+print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; then
+        echo "TPU RECOVERED at $(date)" >> /tmp/tpu_watch.log
+        exit 0
+    fi
+    echo "still down $(date)" >> /tmp/tpu_watch.log
+    sleep 45
+done
