@@ -299,8 +299,67 @@ def scan_words_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray,
     return jax.vmap(one)(ext_b, nv_b)
 
 
+def _block_cum(pos, padded: int, bb: int):
+    """Exclusive prefix counts of candidates per ``2^bb``-byte block.
+
+    ``cum[b]`` = number of valid candidates (``pos < padded``; the
+    compaction pads with sentinel ``padded``) at positions below
+    ``b << bb``.  One scatter-add + one short cumsum, both over
+    ``padded >> bb`` lanes — negligible next to even a single
+    ``searchsorted`` over the candidate array.
+    """
+    nb = (padded >> bb) + 2
+    valid = pos < padded
+    cnt = jnp.zeros(nb, dtype=jnp.int32).at[
+        jnp.where(valid, (pos >> bb).astype(jnp.int32), nb)
+    ].add(1, mode="drop")
+    return jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cnt)[:-1]])
+
+
+def _make_lookup(pos, cum, cap: int, padded: int, bb: int, probes: int = 6):
+    """searchsorted-left on a sorted candidate array in TWO serialized
+    gather rounds instead of ``log2(cap)``.
+
+    ``jnp.searchsorted`` lowers to a binary search: ~15-17 *serialized*
+    gather rounds over the candidate array, and ``_parallel_select``
+    issues ~24 of them — the measured bulk of the 64 KiB-chunk select
+    stage (PERF.md).  Here round 1 reads the block prefix table
+    (:func:`_block_cum`) for a lower bound, round 2 probes the next
+    ``probes+1`` candidates in parallel; sortedness makes the below-query
+    prefix-run length the exact correction.  More than ``probes``
+    candidates in one block (density far beyond the calibrated gear
+    distribution; ``bb`` is sized to keep the expected run < 1/8) sets
+    the overflow flag, which joins the row's existing oracle-fallback
+    path — output stays bit-identical on every input either way.
+
+    Queries beyond ``padded`` clamp: past-the-end results then differ
+    from true searchsorted only in how far PAST the last valid candidate
+    they land, which every call site masks (window checks compare the
+    gathered position against an in-stream bound; gap-jump targets gather
+    the same sentinel either way).
+    """
+    nb1 = cum.shape[0] - 1
+
+    def lookup(q):
+        qc = jnp.clip(q, 0, padded)
+        idx0 = cum[jnp.minimum(qc >> bb, nb1)]
+        adv = jnp.zeros_like(idx0)
+        over = None
+        for k in range(probes + 1):
+            i = idx0 + k
+            below = (i < cap) & (pos[jnp.minimum(i, cap - 1)] < qc)
+            if k < probes:
+                adv = adv + below.astype(jnp.int32)
+            else:
+                over = below
+        return idx0 + adv, over
+
+    return lookup
+
+
 def _parallel_select(pos_l, pos_s, n, *, min_size: int, desired_size: int,
                      max_size: int, s_cap: int, l_cap: int, cut_cap: int,
+                     padded: int, block_bits: int,
                      probe_iters: int = 6):
     """FastCDC cut selection in O(log) depth instead of a sequential loop.
 
@@ -335,16 +394,32 @@ def _parallel_select(pos_l, pos_s, n, *, min_size: int, desired_size: int,
     M = jnp.int32(max_size)
     TERM = jnp.int32(l_cap)
 
+    look_ovf = []  # any-lane overflow per lookup; ORed into the row flag
+    look_s = _make_lookup(pos_s, _block_cum(pos_s, padded, block_bits),
+                          s_cap, padded, block_bits)
+    look_l = _make_lookup(pos_l, _block_cum(pos_l, padded, block_bits),
+                          l_cap, padded, block_bits)
+
+    def ss_s(q):
+        i, ov = look_s(q)
+        look_ovf.append(jnp.any(ov))
+        return i
+
+    def ss_l(q):
+        i, ov = look_l(q)
+        look_ovf.append(jnp.any(ov))
+        return i
+
     def step_from(x):
         """Candidate-window check for starts ``x``: (hit, cut position)."""
         lo1 = x + (m - 1)
         hi1 = jnp.minimum(x + (d - 2), n - 2)
-        i = jnp.searchsorted(pos_s, lo1, side="left")
+        i = ss_s(lo1)
         e1 = pos_s[jnp.minimum(i, s_cap - 1)]
         ok1 = (i < s_cap) & (e1 <= hi1)
         lo2 = x + (d - 1)
         hi2 = jnp.minimum(x + (M - 2), n - 2)
-        j = jnp.searchsorted(pos_l, lo2, side="left")
+        j = ss_l(lo2)
         e2 = pos_l[jnp.minimum(j, l_cap - 1)]
         ok2 = (j < l_cap) & (e2 <= hi2)
         return ok1 | ok2, jnp.where(ok1, e1, e2)
@@ -372,10 +447,8 @@ def _parallel_select(pos_l, pos_s, n, *, min_size: int, desired_size: int,
             done = done | resolved
             # closed-form jump over the candidate-free gap: earliest start
             # that could see the next strict/loose candidate in-window
-            qs = pos_s[jnp.minimum(
-                jnp.searchsorted(pos_s, y + (m - 1), side="left"), s_cap - 1)]
-            ql = pos_l[jnp.minimum(
-                jnp.searchsorted(pos_l, y + (d - 1), side="left"), l_cap - 1)]
+            qs = pos_s[jnp.minimum(ss_s(y + (m - 1)), s_cap - 1)]
+            ql = pos_l[jnp.minimum(ss_l(y + (d - 1)), l_cap - 1)]
             target = jnp.minimum(jnp.minimum(qs - (d - 2), ql - (M - 2)),
                                  n - M)
             steps = jnp.maximum(
@@ -394,8 +467,7 @@ def _parallel_select(pos_l, pos_s, n, *, min_size: int, desired_size: int,
     # next node index: the final cut is itself a loose candidate unless
     # terminal (exact match by construction)
     nxt0 = jnp.where(
-        node_term, TERM,
-        jnp.searchsorted(pos_l, node_final, side="left").astype(jnp.int32))
+        node_term, TERM, ss_l(node_final).astype(jnp.int32))
     emit0 = node_j + 1  # j forced cuts + 1 candidate/terminal cut
     # TERM self-loop emits nothing
     nxt0 = jnp.concatenate([nxt0, TERM[None]])
@@ -417,11 +489,12 @@ def _parallel_select(pos_l, pos_s, n, *, min_size: int, desired_size: int,
     h0_final = final[l_cap]
     h0_un = unres[l_cap]
     b1 = jnp.where(
-        h0_term, TERM,
-        jnp.searchsorted(pos_l, h0_final, side="left").astype(jnp.int32))
+        h0_term, TERM, ss_l(h0_final).astype(jnp.int32))
     h0_emit = h0_j + 1
     total = h0_emit + emits[-1][b1]
     row_unres = h0_un | uns[-1][b1]
+    for ov in look_ovf:
+        row_unres = row_unres | ov
     n_cuts = jnp.where(n > 0, total, 0)
 
     # per-slot table walk
@@ -555,11 +628,18 @@ def scan_select_batch(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
                     | (jnp.sum(is_s.astype(jnp.int32)) > s_cap))
         return pos_l, pos_s, overflow
 
+    # lookup-block size: expected loose-candidate count per block stays
+    # <= 1/8 (density 2^-mask_l_bits), so the 6-probe correction never
+    # overflows on distribution-typical data
+    mask_l_bits = bin(mask_l).count("1")
+    block_bits = max(5, min(11, mask_l_bits - 3))
+
     def one(n, words_l, words_s):
         pos_l, pos_s, ovf = compact_words(words_l, words_s)
         n_cuts, cuts, unres = _parallel_select(
             pos_l, pos_s, n, min_size=min_size, desired_size=desired_size,
-            max_size=max_size, s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap)
+            max_size=max_size, s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap,
+            padded=P, block_bits=block_bits)
         overflow = (ovf | unres).astype(jnp.int32)
         return jnp.concatenate([overflow[None], n_cuts[None], cuts])
 

@@ -1,0 +1,39 @@
+"""Make an explicit ``JAX_PLATFORMS`` env var actually win.
+
+Some rigs re-pin JAX to the hardware plugin via sitecustomize's
+``jax.config.update("jax_platforms", ...)``, which beats the env var —
+the LAST config update before backend initialization wins.  Every
+process entry point that honors ``JAX_PLATFORMS`` (bench, the driver
+contract, the test harness) funnels through this one helper so the
+workaround can't drift between copies.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_platform_from_env() -> None:
+    """Re-assert ``JAX_PLATFORMS`` over a sitecustomize config pin.
+
+    Must run before anything touches a device: once a backend is
+    initialized, ``jax.config.update("jax_platforms", ...)`` silently
+    has no effect.  The initialized-probe reads a private attribute;
+    if that breaks under a newer jax, FAIL OPEN and apply the update
+    anyway (a post-init update is the documented silent no-op, while
+    skipping it would silently re-enable the dead-accelerator-tunnel
+    hang this helper exists to prevent).
+    """
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+
+        initialized = bool(xla_bridge._backends)
+    except Exception:
+        initialized = False
+    if not initialized:
+        jax.config.update("jax_platforms", want)

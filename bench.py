@@ -44,6 +44,9 @@ def main() -> None:
     from backuwup_tpu.utils.jaxcache import enable_compilation_cache
     enable_compilation_cache()
 
+    from backuwup_tpu.utils.platform import pin_platform_from_env
+    pin_platform_from_env()
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -54,8 +57,10 @@ def main() -> None:
     from backuwup_tpu.ops.gear import CDCParams
     from backuwup_tpu.ops.pipeline import DevicePipeline
 
+    import bench_configs
+
     total_gib = float(os.environ.get("BENCH_GIB", "10"))
-    seg_mib = int(os.environ.get("BENCH_SEGMENT_MIB", "256"))
+    seg_mib = bench_configs.segment_mib()
     cpu_mib = int(os.environ.get("BENCH_CPU_MIB", "64"))
     params = CDCParams()  # production 256KiB/1MiB/3MiB
     pipeline = DevicePipeline(params)
@@ -154,8 +159,6 @@ def main() -> None:
     # --- BASELINE configs #2-#6 -------------------------------------------
     configs = {}
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
-        import bench_configs
-
         configs = bench_configs.run_all(pipeline, params, cpu_mibs, log)
 
     print(json.dumps({

@@ -40,6 +40,12 @@ from backuwup_tpu.ops.gear import CDCParams
 from backuwup_tpu.ops.pipeline import DevicePipeline
 
 
+def segment_mib() -> int:
+    """Shared segment-size knob: bench.py's main loop and configs #3/#4
+    must agree or the suite silently benchmarks mixed segment sizes."""
+    return int(os.environ.get("BENCH_SEGMENT_MIB", "256"))
+
+
 def _oracle(data: bytes, params: CDCParams):
     chunks = cdc_cpu.chunk_stream(data, params)
     digests = Blake3Numpy().digest_batch(
@@ -167,7 +173,8 @@ def config3_incremental(pipeline: DevicePipeline, params: CDCParams,
                         log: Callable) -> Dict:
     """Two consecutive snapshots with small edits — BASELINE config #3."""
     snap_mib = int(os.environ.get("BENCH_C3_MIB", "1024"))
-    seg = 256 << 20
+    seg_mib = segment_mib()
+    seg = seg_mib << 20
     n_seg = max(1, (snap_mib << 20) // seg)
     key = jax.random.PRNGKey(31)
 
@@ -208,10 +215,11 @@ def config3_incremental(pipeline: DevicePipeline, params: CDCParams,
             tot += 1
             dup += bytes(d) in dig_a
     ratio = dup / max(tot, 1)
-    mibs = 2 * n_seg * 256 / dt
+    mibs = 2 * n_seg * seg_mib / dt
 
-    # parity + identical dedup ratio on an 8 MiB sub-pair
-    sub = 8 << 20
+    # parity + identical dedup ratio on an 8 MiB sub-pair (clipped to the
+    # segment size so tiny smoke runs don't declare bytes past the buffer)
+    sub = min(8 << 20, seg)
     a8 = bytes(np.asarray(snap_a[0][0, _HALO:_HALO + sub]))
     b8 = bytes(np.asarray(snap_b[0][0, _HALO:_HALO + sub]))
     ca, da = _oracle(a8, params)
@@ -231,7 +239,7 @@ def config3_incremental(pipeline: DevicePipeline, params: CDCParams,
     dev_dup = sum(1 for d in dev_sub[1][1] if bytes(d) in dev_sa)
     if dev_dup != oracle_dup:
         raise RuntimeError("config #3: dedup-ratio divergence on sub-pair")
-    log(f"config#3 incremental: 2x{n_seg * 256} MiB in {dt:.2f}s = "
+    log(f"config#3 incremental: 2x{n_seg * seg_mib} MiB in {dt:.2f}s = "
         f"{mibs:.1f} MiB/s, dedup ratio {ratio:.3f} "
         f"(oracle sub-pair dup {oracle_dup}/{len(cb)})")
     return {"mib_s": round(mibs, 2), "dedup_ratio": round(ratio, 4)}
@@ -242,8 +250,9 @@ def config4_large_stream(log: Callable) -> Dict:
     total_gib = float(os.environ.get("BENCH_C4_GIB", "4"))
     params = CDCParams.from_desired(64 << 10)
     pipeline = DevicePipeline(params, l_bucket=256, b_bucket=512)
-    seg = 256 << 20
-    n_seg = max(2, int(total_gib * 1024) // 256)
+    seg_mib = segment_mib()
+    seg = seg_mib << 20
+    n_seg = max(2, int(total_gib * 1024) // seg_mib)
     pool = _synth_segments(jax.random.PRNGKey(41), min(8, n_seg), seg)
     nv = np.full(1, seg, dtype=np.int32)
     list(pipeline.manifest_segments_device([(pool[0], nv), (pool[1], nv)],
@@ -260,16 +269,16 @@ def config4_large_stream(log: Callable) -> Dict:
         for chunks, _d in results:
             n_chunks += len(chunks)
     dt = time.time() - t0
-    mibs = n_seg * 256 / dt
+    mibs = n_seg * seg_mib / dt
 
-    sub = 8 << 20
+    sub = min(8 << 20, seg)
     data = bytes(np.asarray(pool[0][0, _HALO:_HALO + sub]))
     ext = np.concatenate([np.zeros(_HALO, dtype=np.uint8),
                           np.frombuffer(data, dtype=np.uint8)])
     (dev_sub,), = pipeline.manifest_segments_device(
         [(jnp.asarray(ext.reshape(1, -1)), np.full(1, sub, dtype=np.int32))])
     _check(dev_sub, data, params, "#4")
-    log(f"config#4 large-stream(64KiB): {n_seg * 256 / 1024:.1f} GiB in "
+    log(f"config#4 large-stream(64KiB): {n_seg * seg_mib / 1024:.1f} GiB in "
         f"{dt:.2f}s = {mibs:.1f} MiB/s ({n_chunks} chunks)")
     return {"mib_s": round(mibs, 2), "chunks": n_chunks}
 

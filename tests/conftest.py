@@ -18,10 +18,10 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 # The environment's sitecustomize re-pins JAX_PLATFORMS to the hardware
-# plugin after env setup; the config API wins over both.
-import jax
+# plugin after env setup; the shared helper re-asserts the env pin.
+from backuwup_tpu.utils.platform import pin_platform_from_env
 
-jax.config.update("jax_platforms", "cpu")
+pin_platform_from_env()
 
 # Persistent compilation cache: the blake3/CDC programs are large unrolled
 # graphs; caching compiled executables across pytest runs keeps the suite
