@@ -61,6 +61,135 @@ def _fmix32_u32(x):
     return h
 
 
+def _make_scan_kernel_u32(mask_s: int, mask_l: int, S: int, R32: int):
+    """v2 kernel: the stream stays packed 4 bytes/u32 END TO END.
+
+    v1 transposes the full u8 stream into strip-major layout (the
+    dominant XLA-side cost of the fused scan: a 256 MiB u8 relayout) and
+    re-expands bytes to u32 inside the kernel.  Here the host-side
+    transpose moves S/4 u32 rows (4x fewer elements, register-width
+    lanes), and the kernel never materializes per-byte arrays at all:
+    positions p = 4r+k live in four interleaved (rows, 128) u32 gear
+    planes, a ladder shift by s byte positions is a plane permutation
+    ``k -> (k-s) mod 4`` plus a sublane shift of ``(s+k'-k)/4`` rows,
+    and the 32:1 bit-pack ORs plane bits at ``4r'+k``.  Bit-identical to
+    v1/_pack_bits by construction; the import-time parity gate
+    (:func:`fused_scan_available`) proves it on the live runtime before
+    production use.
+    """
+    HR = _HALO_ROWS // 4  # 8 u32 rows = the 32-byte halo
+
+    def kernel(nv_ref, halo0_ref, main_ref, prev_ref, wl_ref, ws_ref):
+        b = pl.program_id(0)
+        i = pl.program_id(1)
+        halo = jnp.where(i > 0, prev_ref[0], halo0_ref[0])  # (HR, 128) u32
+        w = jnp.concatenate([halo, main_ref[0]], axis=0)  # (R32+HR, 128)
+        rows = R32 + HR
+        # per-byte gear values, one plane per byte-in-word slot
+        g = [_fmix32_u32((w >> jnp.uint32(8 * k)) & jnp.uint32(0xFF))
+             for k in range(4)]
+        # 32-tap windowed sum by log-doubling over byte positions
+        a = list(g)
+        for t in range(5):
+            s = 1 << t
+            nxt = []
+            for k in range(4):
+                src = (k - s) % 4
+                d = (s + src - k) // 4
+                if d:
+                    sh = jnp.concatenate(
+                        [jnp.zeros((d, _LANES), dtype=jnp.uint32),
+                         a[src][:rows - d]], axis=0)
+                else:
+                    sh = a[src]
+                nxt.append(a[k] + (sh << jnp.uint32(s)))
+            a = nxt
+        # main rows only; plane k holds positions 4r+k
+        pos_r = (jax.lax.broadcasted_iota(jnp.int32, (R32, _LANES), 1) * S
+                 + (i * R32
+                    + jax.lax.broadcasted_iota(jnp.int32, (R32, _LANES), 0))
+                 * 4)
+        n = nv_ref[b]
+        wl = jnp.zeros((R32 // 8, _LANES), dtype=jnp.uint32)
+        ws = jnp.zeros((R32 // 8, _LANES), dtype=jnp.uint32)
+        for k in range(4):
+            h = a[k][HR:]
+            valid = (pos_r + k) < n
+            cl = (((h & jnp.uint32(mask_l)) == jnp.uint32(0)) & valid)
+            cs = cl & ((h & jnp.uint32(mask_s)) == jnp.uint32(0))
+            cl3 = cl.astype(jnp.uint32).reshape(R32 // 8, 8, _LANES)
+            cs3 = cs.astype(jnp.uint32).reshape(R32 // 8, 8, _LANES)
+            for r2 in range(8):
+                wl = wl | (cl3[:, r2, :] << jnp.uint32(4 * r2 + k))
+                ws = ws | (cs3[:, r2, :] << jnp.uint32(4 * r2 + k))
+        wl_ref[0] = wl
+        ws_ref[0] = ws
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mask_s", "mask_l", "interpret"))
+def _fused_candidate_words_u32(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
+                               mask_s: int, mask_l: int,
+                               interpret: bool = False):
+    """v2 driver: packed-u32 strip layout (see :func:`_make_scan_kernel_u32`).
+
+    Same contract as :func:`fused_candidate_words` v1: position-major
+    candidate words, bit-identical to the XLA ``_pack_bits`` path.
+    """
+    B, n = ext_b.shape
+    P = n - 31
+    assert P % (128 * 32) == 0, "P must be a multiple of 4096"
+    S = P // _LANES
+    S32 = S // 4
+    R32 = (_DEF_R // 4) if S32 % (_DEF_R // 4) == 0 else S32
+    HR = _HALO_ROWS // 4
+    ext32 = jnp.pad(ext_b, ((0, 0), (1, 0)))
+    # strip-contiguous view, packed 4 bytes/word: FREE reshape+bitcast,
+    # then a u32 transpose (4x fewer elements than v1's u8 transpose)
+    body_w = jax.lax.bitcast_convert_type(
+        ext32[:, 32:].reshape(B, _LANES, S32, 4), jnp.uint32)  # (B,128,S32)
+    body = body_w.transpose(0, 2, 1)  # (B, S32, 128)
+    head_w = jax.lax.bitcast_convert_type(
+        ext32[:, :32].reshape(B, HR, 4), jnp.uint32)  # (B, HR)
+    halo0 = jnp.concatenate(
+        [head_w[:, :, None], body[:, S32 - HR:, :-1]], axis=2)  # (B,HR,128)
+    nv = nv_b.astype(jnp.int32)
+
+    kernel = _make_scan_kernel_u32(mask_s, mask_l, S, R32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, S32 // R32),
+        in_specs=[
+            pl.BlockSpec((1, HR, _LANES), lambda b, i, *_: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, R32, _LANES), lambda b, i, *_: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, HR, _LANES),
+                         lambda b, i, *_: (b, jnp.maximum(
+                             i * (R32 // HR) - 1, 0), 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, R32 // 8, _LANES), lambda b, i, *_: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, R32 // 8, _LANES), lambda b, i, *_: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+    wl, ws = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((B, S // 32, _LANES), jnp.uint32),
+                   jax.ShapeDtypeStruct((B, S // 32, _LANES), jnp.uint32)],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(nv, halo0, body, body)
+    wl = wl.transpose(0, 2, 1).reshape(B, P // 32)
+    ws = ws.transpose(0, 2, 1).reshape(B, P // 32)
+    return wl, ws
+
+
 def _make_scan_kernel(mask_s: int, mask_l: int, S: int, R: int):
     def kernel(nv_ref, halo0_ref, main_ref, prev_ref, wl_ref, ws_ref):
         b = pl.program_id(0)
@@ -98,16 +227,37 @@ def _make_scan_kernel(mask_s: int, mask_l: int, S: int, R: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("mask_s", "mask_l"))
+# selected kernel variant; decided ONCE by fused_scan_available()'s
+# parity ladder before any production trace (the dispatcher below reads
+# it at trace time, so flipping it after a trace would go unnoticed —
+# DevicePipeline/callers always probe first)
+_V2_SELECTED = False
+
+
 def fused_candidate_words(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
                           mask_s: int, mask_l: int):
     """``(B, 31+P) u8 -> ((B, P/32) u32, (B, P/32) u32)`` candidate words.
 
-    Drop-in producer of the loose/strict packed candidate-bit arrays in
-    position-major order (bit-identical to the XLA path's
-    ``_pack_bits(cand)``).  ``P`` must be a multiple of 4096 (every
-    production segment bucket is a power of two >= 64 KiB).
+    Trace-time dispatcher over the kernel variants: v2 (packed-u32
+    strips, no byte-stream relayout) when the parity ladder selected it
+    on this runtime, else v1.  Both are bit-identical to the XLA path's
+    ``_pack_bits(cand)``; ``P`` must be a multiple of 4096.
     """
+    # run the ladder if no caller has yet (lru_cached: once per process)
+    # so standalone probes/scripts measure the variant production uses
+    fused_scan_available()
+    if _V2_SELECTED:
+        return _fused_candidate_words_u32(ext_b, nv_b,
+                                          mask_s=mask_s, mask_l=mask_l)
+    return _fused_candidate_words_v1(ext_b, nv_b,
+                                     mask_s=mask_s, mask_l=mask_l)
+
+
+@functools.partial(jax.jit, static_argnames=("mask_s", "mask_l"))
+def _fused_candidate_words_v1(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
+                              mask_s: int, mask_l: int):
+    """v1 driver: u8 strip layout (full-stream byte transpose on the
+    XLA side; see module docstring)."""
     B, n = ext_b.shape
     P = n - 31
     assert P % (128 * 32) == 0, "P must be a multiple of 4096"
@@ -159,22 +309,9 @@ def fused_candidate_words(ext_b: jnp.ndarray, nv_b: jnp.ndarray, *,
     return wl, ws
 
 
-@functools.lru_cache(maxsize=1)
-def fused_scan_available() -> bool:
-    """True when the fused scan kernel lowers and matches the XLA oracle
-    on this runtime (checked once, on first use)."""
-    import os
-
-    if os.environ.get("BKW_FUSED", "1") == "0":
-        return False
-    if pl is None:
-        return False
-    try:
-        platform = jax.devices()[0].platform
-    except RuntimeError:  # pragma: no cover
-        return False
-    if platform not in ("tpu", "axon"):
-        return False
+def _variant_parity_ok(fn) -> bool:
+    """Does ``fn`` (a candidate-words producer) match the XLA oracle on
+    the live runtime?  Lowering failures count as mismatch."""
     try:
         import numpy as np
 
@@ -185,8 +322,8 @@ def fused_scan_available() -> bool:
         ext = rng.integers(0, 256, (2, 31 + P), dtype=np.uint8)
         nv = np.array([P, P - 12345], dtype=np.int32)
         mask_s, mask_l = 0xFFF00000, 0xFFF80000
-        wl, ws = fused_candidate_words(jnp.asarray(ext), jnp.asarray(nv),
-                                       mask_s=mask_s, mask_l=mask_l)
+        wl, ws = fn(jnp.asarray(ext), jnp.asarray(nv),
+                    mask_s=mask_s, mask_l=mask_l)
         for r in range(2):
             h = _hash_ext_fast(jnp.asarray(ext[r]))
             rl, rs = _candidate_words(h, jnp.int32(nv[r]),
@@ -197,3 +334,34 @@ def fused_scan_available() -> bool:
         return True
     except Exception:  # pragma: no cover - lowering failure
         return False
+
+
+@functools.lru_cache(maxsize=1)
+def fused_scan_available() -> bool:
+    """True when a fused scan kernel lowers and matches the XLA oracle on
+    this runtime (checked once, on first use).
+
+    Variant ladder: v2 (packed-u32) is preferred and selected only if it
+    proves bit-parity here; otherwise v1 is probed.  A variant that
+    mis-lowers on some runtime therefore degrades throughput, never
+    correctness.
+    """
+    import os
+
+    global _V2_SELECTED
+    if os.environ.get("BKW_FUSED", "1") == "0":
+        return False
+    if pl is None:
+        return False
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:  # pragma: no cover
+        return False
+    if platform not in ("tpu", "axon"):
+        return False
+    if (os.environ.get("BKW_FUSED_V2", "1") != "0"
+            and _variant_parity_ok(_fused_candidate_words_u32)):
+        _V2_SELECTED = True
+        return True
+    _V2_SELECTED = False
+    return _variant_parity_ok(_fused_candidate_words_v1)

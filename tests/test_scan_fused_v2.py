@@ -1,0 +1,65 @@
+"""v2 (packed-u32) fused-scan kernel logic vs the XLA oracle.
+
+The Mosaic lowering itself can only be proven on TPU (the import-time
+parity ladder in ``scan_fused.fused_scan_available`` does that on the
+live runtime); here the kernel BODY runs in pallas interpret mode on
+CPU, which validates the plane-permutation ladder, halo plumbing, and
+bit-pack math that v2 reimplements.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from backuwup_tpu.ops import scan_fused
+from backuwup_tpu.ops.cdc_tpu import _candidate_words, _hash_ext_fast
+
+if scan_fused.pl is None:  # pragma: no cover
+    pytest.skip("pallas not importable", allow_module_level=True)
+
+
+def _interpret_mode_works() -> bool:
+    """Probe interpret-mode availability with a TRIVIAL kernel, so real
+    v2 bugs fail the test instead of hiding behind a skip."""
+    pl = scan_fused.pl
+
+    def k(o_ref):
+        o_ref[...] = jnp.ones_like(o_ref)
+
+    try:
+        out = pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.uint32),
+            interpret=True)()
+        return bool(np.asarray(out).all())
+    except Exception:  # pragma: no cover - interpreter gap on this host
+        return False
+
+
+import jax  # noqa: E402  (after the pallas-importable gate above)
+
+if not _interpret_mode_works():  # pragma: no cover
+    pytest.skip("pallas interpret mode unavailable on this host",
+                allow_module_level=True)
+
+
+@pytest.mark.parametrize("case", ["random", "zeros", "short_rows"])
+def test_v2_kernel_matches_xla_oracle(case):
+    rng = np.random.default_rng(42)
+    P = 64 * 1024
+    B = 2
+    ext = rng.integers(0, 256, (B, 31 + P), dtype=np.uint8)
+    if case == "zeros":
+        ext[0] = 0
+    nv = np.array([P, P - 12345 if case == "short_rows" else P],
+                  dtype=np.int32)
+    mask_s, mask_l = 0xFFF00000, 0xFFF80000
+    wl, ws = scan_fused._fused_candidate_words_u32(
+        jnp.asarray(ext), jnp.asarray(nv),
+        mask_s=mask_s, mask_l=mask_l, interpret=True)
+    for r in range(B):
+        h = _hash_ext_fast(jnp.asarray(ext[r]))
+        rl, rs = _candidate_words(h, jnp.int32(nv[r]),
+                                  jnp.uint32(mask_s), jnp.uint32(mask_l))
+        assert np.array_equal(np.asarray(wl[r]), np.asarray(rl)), case
+        assert np.array_equal(np.asarray(ws[r]), np.asarray(rs)), case
