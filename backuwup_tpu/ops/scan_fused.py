@@ -318,7 +318,10 @@ def _variant_parity_ok(fn) -> bool:
         from .cdc_tpu import _candidate_words, _hash_ext_fast
 
         rng = np.random.default_rng(7)
-        P = 64 * 1024
+        # 1 MiB rows = 4 grid steps for both variants (v1 R=2048 of
+        # S=8192 rows; v2 R32=512 of S32=2048): the probe must exercise
+        # the multi-tile prev-halo path, not just tile 0's halo0 branch
+        P = 1 << 20
         ext = rng.integers(0, 256, (2, 31 + P), dtype=np.uint8)
         nv = np.array([P, P - 12345], dtype=np.int32)
         mask_s, mask_l = 0xFFF00000, 0xFFF80000

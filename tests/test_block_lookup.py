@@ -1,0 +1,86 @@
+"""Block-skip lookup (= searchsorted-left) unit equivalence.
+
+End-to-end bit-parity of the selection lives in
+``tests/test_parallel_select.py``; here the lookup primitive itself is
+pinned against ``np.searchsorted`` — including the overflow contract:
+whenever the probe window underestimates (more than ``probes``
+candidates between a query's block start and the query), the overflow
+flag MUST be set, because an unflagged wrong index would silently
+corrupt cut selection instead of routing the row to the oracle.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from backuwup_tpu.ops.cdc_tpu import _block_cum, _make_lookup
+
+BB = 7  # 128-byte blocks keep the dense cases interesting
+
+
+def _build(pos_np, cap, padded):
+    pos = jnp.asarray(pos_np.astype(np.int32))
+    cum = _block_cum(pos, padded, BB)
+    return _make_lookup(pos, cum, cap, padded, BB)
+
+
+def _check(pos_np, queries, cap, padded):
+    look = _build(pos_np, cap, padded)
+    idx, over = look(jnp.asarray(queries.astype(np.int32)))
+    idx = np.asarray(idx)
+    over = np.asarray(over)
+    want = np.searchsorted(pos_np, np.clip(queries, 0, padded), side="left")
+    bad = (idx != want) & ~over
+    assert not bad.any(), (
+        f"unflagged divergence at {np.nonzero(bad)[0][:5]}: "
+        f"got {idx[bad][:5]}, want {want[bad][:5]}")
+    return over
+
+
+def test_sparse_exact_no_overflow(nprng):
+    padded = 1 << 16
+    cap = 256
+    vals = np.sort(nprng.choice(padded - 1, size=120, replace=False))
+    pos = np.full(cap, padded, dtype=np.int64)
+    pos[:120] = vals
+    queries = np.concatenate([
+        nprng.integers(-5, padded + 40, size=500),
+        vals, vals + 1, vals - 1,  # boundary hits on every side
+        np.array([0, padded, padded - 1]),
+    ])
+    over = _check(pos, queries, cap, padded)
+    # density ~0.23/block: the 6-probe window must never overflow here
+    assert not over.any()
+
+
+def test_dense_block_flags_overflow(nprng):
+    padded = 1 << 14
+    cap = 64
+    # 10 candidates crammed into one 128-byte block: any query beyond
+    # them in the same block exceeds the probe window and must flag
+    base = 4 * 128
+    pos = np.full(cap, padded, dtype=np.int64)
+    pos[:10] = base + np.arange(10)
+    queries = np.array([base + 9, base + 10, base + 127,  # inside the block
+                        base, base + 3, base + 200])
+    over = _check(pos, queries, cap, padded)
+    assert over[:3].all(), "dense-run queries must flag overflow"
+    assert not over[3:5].any(), "short-run queries stay exact"
+
+
+def test_full_array_no_sentinels(nprng):
+    padded = 1 << 14
+    cap = 32
+    pos = np.sort(nprng.choice(np.arange(0, padded, 130), size=cap,
+                               replace=False)).astype(np.int64)
+    queries = np.concatenate([pos, pos + 1, [0, padded],
+                              nprng.integers(0, padded, size=200)])
+    _check(pos, queries, cap, padded)
+
+
+def test_empty_table():
+    padded = 1 << 13
+    cap = 16
+    pos = np.full(cap, padded, dtype=np.int64)
+    over = _check(pos, np.array([0, 1, 5000, padded]), cap, padded)
+    assert not over.any()

@@ -43,10 +43,13 @@ if not _interpret_mode_works():  # pragma: no cover
                 allow_module_level=True)
 
 
-@pytest.mark.parametrize("case", ["random", "zeros", "short_rows"])
+@pytest.mark.parametrize("case", ["random", "zeros", "short_rows",
+                                  "multi_tile"])
 def test_v2_kernel_matches_xla_oracle(case):
     rng = np.random.default_rng(42)
-    P = 64 * 1024
+    # multi_tile: S32 = P/512 = 2048 > R32 = 512 -> 4 grid steps, so the
+    # prev-tile halo branch (i > 0) is exercised, not just halo0
+    P = (1 << 20) if case == "multi_tile" else 64 * 1024
     B = 2
     ext = rng.integers(0, 256, (B, 31 + P), dtype=np.uint8)
     if case == "zeros":
