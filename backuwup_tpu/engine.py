@@ -38,9 +38,9 @@ from .crypto import KeyManager
 from .net.client import NoBackups, ServerClient, ServerError
 from .net.p2p import P2PError, P2PNode, Receiver, RestoreFilesWriter, Transport
 from .ops.backend import ChunkerBackend, select_backend
-from .snapshot.blob_index import BlobIndex, index_file_name
+from .snapshot.blob_index import BlobIndex
 from .snapshot.packer import DirPacker
-from .snapshot.packfile import PackfileReader, PackfileWriter, packfile_path
+from .snapshot.packfile import PackfileReader, PackfileWriter
 from .store import EVENT_BACKUP, EVENT_RESTORE_REQUEST, Store
 from .utils import tracing
 

@@ -24,7 +24,6 @@ from __future__ import annotations
 import asyncio
 import os
 import time
-from pathlib import Path
 from typing import Callable, Dict, Optional
 
 import numpy as np

@@ -25,7 +25,6 @@ import asyncio
 import os
 import sqlite3
 import time
-from pathlib import Path
 from typing import Dict, Optional
 
 from aiohttp import WSMsgType, web

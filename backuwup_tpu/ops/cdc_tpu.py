@@ -42,7 +42,7 @@ from jax.sharding import PartitionSpec as P
 from .. import defaults
 from .cdc_cpu import cuts_to_chunks, select_cuts
 from .cdc_cpu import gear_hashes as gear_hashes_np
-from .gear import GEAR, GEAR_WINDOW, CDCParams
+from .gear import GEAR_WINDOW, CDCParams
 
 _HALO = GEAR_WINDOW - 1  # 31 bytes of left context carry the full hash state
 

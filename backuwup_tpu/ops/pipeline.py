@@ -29,22 +29,19 @@ from __future__ import annotations
 
 import functools
 from collections import deque
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import defaults
 from ..utils import tracing
 from .blake3_tpu import blake3_many_tpu, digest_padded
 from .cdc_cpu import chunk_stream as chunk_stream_cpu
 from .cdc_tpu import (
     _HALO,
     TpuCdcScanner,
-    _decode_words,
     _round_up,
-    _scan_segment,
     _segment_bucket,
     scan_select_batch,
 )

@@ -39,10 +39,8 @@ from ..crypto import KeyManager
 from ..utils import zstd
 from ..utils.serialization import Reader, Writer
 from ..wire import (
-    BLOB_HASH_LEN,
     PACKFILE_ID_LEN,
     Blob,
-    BlobKind,
     CompressionKind,
     PackfileHeaderBlob,
 )

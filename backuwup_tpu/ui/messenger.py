@@ -13,7 +13,7 @@ import json
 import time
 from collections import deque
 from dataclasses import asdict, dataclass, field
-from typing import Callable, List, Optional
+from typing import Callable, List
 
 from .. import defaults
 

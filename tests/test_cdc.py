@@ -6,7 +6,7 @@ import pytest
 from backuwup_tpu.ops.cdc_cpu import (candidate_positions, chunk_stream,
                                       chunk_stream_scalar, gear_hashes,
                                       gear_hashes_scalar, select_cuts)
-from backuwup_tpu.ops.gear import GEAR, GEAR_WINDOW, CDCParams
+from backuwup_tpu.ops.gear import GEAR, CDCParams
 
 SMALL = CDCParams.from_desired(1024)  # min 256 / desired 1024 / max 3072
 

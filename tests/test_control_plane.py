@@ -4,9 +4,8 @@ import asyncio
 
 import pytest
 
-from backuwup_tpu import wire
 from backuwup_tpu.crypto import KeyManager
-from backuwup_tpu.net.client import ServerClient, ServerError, Unauthorized
+from backuwup_tpu.net.client import ServerClient, ServerError
 from backuwup_tpu.net.server import CoordinationServer
 from backuwup_tpu.store import Store
 

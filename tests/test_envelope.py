@@ -6,8 +6,6 @@ writer's effective cap must be the wire max minus envelope overhead)."""
 
 import random
 
-import pytest
-
 from backuwup_tpu import defaults, wire
 from backuwup_tpu.crypto import KeyManager
 from backuwup_tpu.net.p2p import _sign_body

@@ -7,8 +7,6 @@ import os
 import random
 from pathlib import Path
 
-import pytest
-
 from backuwup_tpu import defaults
 from backuwup_tpu.crypto import KeyManager
 from backuwup_tpu.ops.backend import CpuBackend

@@ -5,7 +5,6 @@ import asyncio
 import io
 import json
 import random
-from pathlib import Path
 
 import aiohttp
 import pytest
