@@ -77,7 +77,8 @@ class SustainedWindow:
     def passes(self):
         """Yield pass indices for the window (coarse paths: one pass =
         the whole stated workload)."""
-        while self.count == 0 or time.time() - self.t0 < min_wall_s():
+        while (self.count < max(1, self.n_min)
+               or time.time() - self.t0 < min_wall_s()):
             yield self.count
             self.count += 1
 
@@ -422,10 +423,13 @@ def config5_cross_peer(log: Callable) -> Dict:
     # sustained window: keep issuing device-resident probe batches (the
     # dominant steady-state operation — inserts are capped by the table's
     # load-factor budget, probes are not)
+    # own window, independent of how long the inserts took: the
+    # sustained-read metric must exist even when the insert phase alone
+    # exceeds the budget
     probes = 0
     probe_chain = []
     t1 = time.time()
-    while time.time() - t0 < min_wall_s():
+    while time.time() - t1 < min_wall_s():
         probe_chain.append(index.probe_device(qs[probes % len(qs)]))
         probes += 1
         if len(probe_chain) >= 8:

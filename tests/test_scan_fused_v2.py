@@ -44,18 +44,20 @@ if not _interpret_mode_works():  # pragma: no cover
 
 
 @pytest.mark.parametrize("case", ["random", "zeros", "short_rows",
-                                  "multi_tile"])
+                                  "multi_tile", "min_p", "single_row"])
 def test_v2_kernel_matches_xla_oracle(case):
     rng = np.random.default_rng(42)
     # multi_tile: S32 = P/512 = 2048 > R32 = 512 -> 4 grid steps, so the
-    # prev-tile halo branch (i > 0) is exercised, not just halo0
-    P = (1 << 20) if case == "multi_tile" else 64 * 1024
-    B = 2
+    # prev-tile halo branch (i > 0) is exercised, not just halo0;
+    # min_p: P=4096 makes R32 == HR == 8 (tightest legal geometry)
+    P = {"multi_tile": 1 << 20, "min_p": 4096}.get(case, 64 * 1024)
+    B = 1 if case == "single_row" else 2
     ext = rng.integers(0, 256, (B, 31 + P), dtype=np.uint8)
     if case == "zeros":
         ext[0] = 0
-    nv = np.array([P, P - 12345 if case == "short_rows" else P],
-                  dtype=np.int32)
+    nv = np.full(B, P, dtype=np.int32)
+    if case == "short_rows":
+        nv[1] = P - 12345
     mask_s, mask_l = 0xFFF00000, 0xFFF80000
     wl, ws = scan_fused._fused_candidate_words_u32(
         jnp.asarray(ext), jnp.asarray(nv),
