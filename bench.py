@@ -75,7 +75,10 @@ def main() -> None:
             "metric": "dedup pipeline chunk+hash throughput (device-resident)",
             "value": 0.0, "unit": "MiB/s", "vs_baseline": 0.0,
             "error": "device init timed out (accelerator tunnel down?); "
-                     "see BENCH_INIT_TIMEOUT_S"}))
+                     "see BENCH_INIT_TIMEOUT_S",
+            "note": "no measurement this run — the device never "
+                    "initialized; PERF.md and the last BENCH_r*.json "
+                    "hold the most recent measured numbers"}))
         return
     if init_err:
         raise init_err[0]  # fast init failure: propagate the real error
