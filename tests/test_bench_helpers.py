@@ -1,0 +1,33 @@
+"""SustainedWindow contract: stated scale AND minimum wall clock."""
+
+import time
+
+import bench_configs
+
+
+def test_items_honors_n_min_with_sustain_disabled(monkeypatch):
+    monkeypatch.setenv("BENCH_MIN_WALL_S", "0")
+    w = bench_configs.SustainedWindow(5)
+    got = list(w.items(["a", "b"]))
+    assert got == ["a", "b", "a", "b", "a"]
+    assert w.count == 5
+
+
+def test_passes_honors_n_min_with_sustain_disabled(monkeypatch):
+    monkeypatch.setenv("BENCH_MIN_WALL_S", "0")
+    w = bench_configs.SustainedWindow(3)
+    assert list(w.passes()) == [0, 1, 2]
+    assert w.count == 3
+
+
+def test_window_extends_to_min_wall(monkeypatch):
+    monkeypatch.setenv("BENCH_MIN_WALL_S", "0.2")
+    w = bench_configs.SustainedWindow(1)
+    n = 0
+    for _ in w.passes():
+        n += 1
+        time.sleep(0.05)
+    # the contract is "extends past n_min until min wall", not an exact
+    # pass count (sleep overshoot on a loaded box would make that flaky)
+    assert n >= 2
+    assert w.wall >= 0.2
