@@ -94,9 +94,11 @@ def _bytes_to_words(buf: jnp.ndarray) -> jnp.ndarray:
             | (b[..., 2] << jnp.uint32(16)) | (b[..., 3] << jnp.uint32(24)))
 
 
-@functools.partial(jax.jit, static_argnames=("L", "pallas"))
+@functools.partial(jax.jit, static_argnames=("L", "pallas",
+                                             "pallas_interpret"))
 def digest_padded(buf: jnp.ndarray, lens: jnp.ndarray, *, L: int,
-                  pallas: bool = False) -> jnp.ndarray:
+                  pallas: bool = False,
+                  pallas_interpret: bool = False) -> jnp.ndarray:
     """Digest a zero-padded batch.
 
     ``buf``: (B, L*1024) u8; ``lens``: (B,) true byte lengths (i32).
@@ -143,7 +145,8 @@ def digest_padded(buf: jnp.ndarray, lens: jnp.ndarray, *, L: int,
     zeros = jnp.zeros(lanes, dtype=jnp.uint32)
 
     if pallas:
-        cv_mat, cvp_mat = _leaf_scan_pallas(words_flat, nb, lbl, counter_lo)
+        cv_mat, cvp_mat = _leaf_scan_pallas(words_flat, nb, lbl, counter_lo,
+                                            interpret=pallas_interpret)
         leaf_cv = [cv_mat[:, i].reshape(B, L) for i in range(8)]
         # single-chunk ROOT recompute from the penultimate CV + the last
         # block of chunk 0, rebuilt here (B lanes — negligible)
@@ -326,8 +329,14 @@ def pallas_digest_available() -> bool:
 
 
 def _leaf_scan_pallas(words: jnp.ndarray, n_blocks: jnp.ndarray,
-                      last_len: jnp.ndarray, chunk_idx: jnp.ndarray):
-    """(lanes, 16, 16) u32 leaf words -> (lanes, 8) cv, (lanes, 8) cv_pre."""
+                      last_len: jnp.ndarray, chunk_idx: jnp.ndarray,
+                      interpret: bool = False):
+    """(lanes, 16, 16) u32 leaf words -> (lanes, 8) cv, (lanes, 8) cv_pre.
+
+    ``interpret=True`` runs the kernel body in the pallas interpreter
+    (CPU tests prove the logic; the Mosaic lowering itself is proven by
+    :func:`pallas_digest_available`'s runtime parity gate).
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -369,6 +378,7 @@ def _leaf_scan_pallas(words: jnp.ndarray, n_blocks: jnp.ndarray,
         ],
         out_shape=[jax.ShapeDtypeStruct((g, 8 * _LROWS, 128), jnp.uint32),
                    jax.ShapeDtypeStruct((g, 8 * _LROWS, 128), jnp.uint32)],
+        interpret=interpret,
     )(nb, lbl, cidx, wt)
     # (g, 8 words, R, 128) -> (lanes, 8)
     def unpack(x):

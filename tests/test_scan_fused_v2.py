@@ -12,33 +12,11 @@ import pytest
 
 import jax.numpy as jnp
 
+from conftest import pallas_interpret_works
 from backuwup_tpu.ops import scan_fused
 from backuwup_tpu.ops.cdc_tpu import _candidate_words, _hash_ext_fast
 
-if scan_fused.pl is None:  # pragma: no cover
-    pytest.skip("pallas not importable", allow_module_level=True)
-
-
-def _interpret_mode_works() -> bool:
-    """Probe interpret-mode availability with a TRIVIAL kernel, so real
-    v2 bugs fail the test instead of hiding behind a skip."""
-    pl = scan_fused.pl
-
-    def k(o_ref):
-        o_ref[...] = jnp.ones_like(o_ref)
-
-    try:
-        out = pl.pallas_call(
-            k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.uint32),
-            interpret=True)()
-        return bool(np.asarray(out).all())
-    except Exception:  # pragma: no cover - interpreter gap on this host
-        return False
-
-
-import jax  # noqa: E402  (after the pallas-importable gate above)
-
-if not _interpret_mode_works():  # pragma: no cover
+if not pallas_interpret_works():  # pragma: no cover
     pytest.skip("pallas interpret mode unavailable on this host",
                 allow_module_level=True)
 
