@@ -79,9 +79,9 @@ async def _run_client(args) -> int:
     probe.close()
     if not has_identity:
         if args.restore_phrase:
-            from .crypto import phrase_to_secret
+            from .crypto import parse_recovery
             try:
-                root_secret = phrase_to_secret(args.restore_phrase)
+                root_secret = parse_recovery(args.restore_phrase)
             except ValueError as e:
                 print(f"invalid --restore-phrase: {e}", file=sys.stderr)
                 return 2
@@ -182,7 +182,8 @@ def main(argv=None) -> int:
                                      "127.0.0.1:8102)")
     c.add_argument("--backup-path", help="directory to back up")
     c.add_argument("--restore-phrase",
-                   help="recover an identity from this phrase (first run)")
+                   help="recover an identity from this phrase — 24-word "
+                        "mnemonic or base32 code (first run)")
     c.add_argument("--non-interactive", action="store_true",
                    help="never prompt; generate a fresh identity if none")
     c.add_argument("--no-tls", action="store_true",

@@ -72,9 +72,10 @@ class ClientApp:
 
     @classmethod
     def from_phrase(cls, phrase: str, **kwargs) -> "ClientApp":
-        """Rebuild an identity from its recovery phrase (cli.rs:26-51)."""
-        from .crypto import phrase_to_secret
-        return cls(root_secret=phrase_to_secret(phrase), **kwargs)
+        """Rebuild an identity from its recovery phrase — word or base32
+        form (cli.rs:26-51)."""
+        from .crypto import parse_recovery
+        return cls(root_secret=parse_recovery(phrase), **kwargs)
 
     @property
     def client_id(self) -> bytes:

@@ -12,9 +12,11 @@ and mnemonic identity flow (``client/src/ui/cli.rs:26-77``):
   as context, the packfile-header key uses ``b"header"``, the index key
   ``b"index"`` (``packfile/pack.rs:58-79``, ``blob_index.rs:16-19``).
 * The root secret round-trips through a human-readable **recovery phrase**
-  (the reference prints a BIP39 mnemonic, ``cli.rs:55-77``; here a
-  self-contained Crockford-base32 group code with a checksum, since identity
-  restore must not depend on an external wordlist).
+  in two equivalent forms, both accepted on restore: a 24-word mnemonic
+  from an embedded 2048-word list (the reference prints a BIP39 mnemonic,
+  ``cli.rs:55-77``; the wordlist is vendored in-package so restore never
+  depends on an external file) and a Crockford-base32 group code with a
+  checksum (canonical/compact form).
 
 Host-side only: crypto is I/O-path work, not TPU compute (SURVEY.md §2.4).
 """
@@ -108,9 +110,12 @@ _CHECK_LEN = 4
 _GROUP = 8
 
 
+def _check_tag(secret: bytes) -> bytes:
+    return hmac.new(b"backuwup-recovery-v1", secret, "sha256").digest()
+
+
 def _checksum(secret: bytes) -> str:
-    tag = hmac.new(b"backuwup-recovery-v1", secret, "sha256").digest()
-    v = int.from_bytes(tag[:4], "big")
+    v = int.from_bytes(_check_tag(secret)[:4], "big")
     return "".join(_B32[(v >> (5 * i)) & 31] for i in range(_CHECK_LEN))
 
 
@@ -142,3 +147,76 @@ def phrase_to_secret(phrase: str) -> bytes:
     if "".join(_B32[d] for d in digits[52:]) != _checksum(secret):
         raise ValueError("recovery phrase checksum mismatch")
     return secret
+
+
+# --------------------------------------------------------------------------
+# Recovery phrase, word form: 24 words from the embedded 2048-word list
+# (the reference prints a BIP39 mnemonic via the bip39 crate, cli.rs:55-77;
+# here the wordlist is vendored in-package, see backuwup_tpu/wordlist.py)
+# --------------------------------------------------------------------------
+
+_WORD_BITS = 11
+_WORD_COUNT = 24  # 264 bits = 256 secret + 8 checksum, the BIP39 shape
+
+
+def secret_to_words(secret: bytes) -> str:
+    """32-byte secret -> 24 space-separated words (word form of the phrase).
+
+    Layout mirrors the base32 codec: little-endian 11-bit limbs of
+    ``secret-int | checksum-byte << 256``.
+    """
+    if len(secret) != ROOT_SECRET_LEN:
+        raise ValueError("root secret must be 32 bytes")
+    from .wordlist import WORDS
+    v = int.from_bytes(secret, "big") | _check_tag(secret)[4] << 256
+    return " ".join(WORDS[(v >> (_WORD_BITS * i)) & 2047]
+                    for i in range(_WORD_COUNT))
+
+
+def _resolve_word(token: str) -> int:
+    """Word -> index; exact match, else unique >=4-char prefix (error
+    tolerance for truncated transcriptions, BIP39's 4-letter convention)."""
+    from .wordlist import WORD_INDEX, WORDS
+    idx = WORD_INDEX.get(token)
+    if idx is not None:
+        return idx
+    if len(token) >= 4:
+        hits = [i for i, w in enumerate(WORDS) if w.startswith(token)]
+        if len(hits) == 1:
+            return hits[0]
+        if len(hits) > 1:
+            raise ValueError(f"ambiguous word prefix: {token!r}")
+    raise ValueError(f"unknown recovery word: {token!r}")
+
+
+def words_to_secret(phrase: str) -> bytes:
+    """Inverse of :func:`secret_to_words`; raises ValueError on typos."""
+    tokens = phrase.strip().lower().replace("-", " ").replace(",", " ").split()
+    if len(tokens) != _WORD_COUNT:
+        raise ValueError(f"word phrase must have {_WORD_COUNT} words "
+                         f"(got {len(tokens)})")
+    v = 0
+    for i, tok in enumerate(tokens):
+        v |= _resolve_word(tok) << (_WORD_BITS * i)
+    secret = (v & ((1 << 256) - 1)).to_bytes(32, "big")
+    if v >> 256 != _check_tag(secret)[4]:
+        raise ValueError("word phrase checksum mismatch")
+    return secret
+
+
+def parse_recovery(phrase: str) -> bytes:
+    """Decode a recovery phrase in EITHER form (words or base32 groups).
+
+    Tries the word form first (a base32 string can never resolve as 24
+    list words), then the base32 form; surfaces the error of whichever
+    form the input most resembles.
+    """
+    looks_wordy = len(phrase.split()) >= _WORD_COUNT // 2
+    try:
+        return words_to_secret(phrase)
+    except ValueError as word_err:
+        try:
+            return phrase_to_secret(phrase)
+        except ValueError as b32_err:
+            raise ValueError(str(word_err if looks_wordy else b32_err)) \
+                from None

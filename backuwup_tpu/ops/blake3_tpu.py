@@ -206,9 +206,21 @@ def digest_padded(buf: jnp.ndarray, lens: jnp.ndarray, *, L: int,
     # --- tree reduction: pair-merge, unpaired node rides up ----------------
     root_cv = [jnp.where(is_single, rs, jnp.uint32(0))
                for rs in root_single]
+    return tree_reduce_cvs(leaf_cv, n_chunks, root_cv)
+
+
+def tree_reduce_cvs(leaf_cv, counts, root_cv):
+    """BLAKE3 tree reduction over per-input leaf chaining values.
+
+    ``leaf_cv``: list of 8 (B, L) u32 columns; ``counts``: (B,) true leaf
+    counts (>=1); ``root_cv``: list of 8 (B,) columns pre-seeded with the
+    single-leaf roots (used where counts == 1).  Pair-merges level by
+    level; an unpaired rightmost node rides up unchanged, reproducing
+    BLAKE3's largest-power-of-two-left split exactly.  Returns (B, 8).
+    """
+    B = leaf_cv[0].shape[0]
     cvs = leaf_cv  # list of 8 (B, cur) arrays
-    counts = n_chunks
-    cur = L
+    cur = leaf_cv[0].shape[1]
     while cur > 1:
         Pn = cur // 2
         left = [c[:, 0:2 * Pn:2] for c in cvs]   # (B, Pn)
@@ -317,12 +329,19 @@ def pallas_digest_available() -> bool:
         return False
     try:
         rng = np.random.default_rng(3)
-        buf = rng.integers(0, 256, (8, 8 * CHUNK_LEN), dtype=np.uint8)
-        lens = np.array([0, 1, 64, 65, 1024, 1025, 4000, 8192], np.int32)
+        # B*L = 12288 lanes = 3 grid steps (> _LEAF_LANES): the probe must
+        # exercise the multi-grid-step index map on the live runtime — a
+        # g>1-specific mis-lowering would otherwise pass a g=1 probe and
+        # silently corrupt digests in production class tiles.
+        B = 1536
+        buf = rng.integers(0, 256, (B, 8 * CHUNK_LEN), dtype=np.uint8)
+        lens = np.resize(
+            np.array([0, 1, 64, 65, 1024, 1025, 4000, 8192], np.int32), B)
         a = np.asarray(digest_padded(jnp.asarray(buf), jnp.asarray(lens),
                                      L=8, pallas=False))
         b = np.asarray(digest_padded(jnp.asarray(buf), jnp.asarray(lens),
                                      L=8, pallas=True))
+        assert B * 8 > _LEAF_LANES  # keep the probe multi-step if consts move
         return bool((a == b).all())
     except Exception:  # pragma: no cover - lowering failure
         return False

@@ -394,32 +394,38 @@ def _parallel_select(pos_l, pos_s, n, *, min_size: int, desired_size: int,
     M = jnp.int32(max_size)
     TERM = jnp.int32(l_cap)
 
-    look_ovf = []  # any-lane overflow per lookup; ORed into the row flag
+    # Any-lane probe overflow is ORed into the row's unresolved flag, but
+    # ONLY for lanes whose lookup result is actually consumed: sentinel-
+    # clamped past-the-end queries and already-resolved/terminal lanes
+    # always probe the stream's densest block, and counting them would
+    # drop whole rows to the CPU oracle on locally dense (non-adversarial)
+    # data even though every consumed lookup succeeded.
+    look_ovf = []
     look_s = _make_lookup(pos_s, _block_cum(pos_s, padded, block_bits),
                           s_cap, padded, block_bits)
     look_l = _make_lookup(pos_l, _block_cum(pos_l, padded, block_bits),
                           l_cap, padded, block_bits)
 
-    def ss_s(q):
+    def ss_s(q, use=None):
         i, ov = look_s(q)
-        look_ovf.append(jnp.any(ov))
+        look_ovf.append(jnp.any(ov if use is None else ov & use))
         return i
 
-    def ss_l(q):
+    def ss_l(q, use=None):
         i, ov = look_l(q)
-        look_ovf.append(jnp.any(ov))
+        look_ovf.append(jnp.any(ov if use is None else ov & use))
         return i
 
-    def step_from(x):
+    def step_from(x, use=None):
         """Candidate-window check for starts ``x``: (hit, cut position)."""
         lo1 = x + (m - 1)
         hi1 = jnp.minimum(x + (d - 2), n - 2)
-        i = ss_s(lo1)
+        i = ss_s(lo1, use)
         e1 = pos_s[jnp.minimum(i, s_cap - 1)]
         ok1 = (i < s_cap) & (e1 <= hi1)
         lo2 = x + (d - 1)
         hi2 = jnp.minimum(x + (M - 2), n - 2)
-        j = ss_l(lo2)
+        j = ss_l(lo2, use)
         e2 = pos_l[jnp.minimum(j, l_cap - 1)]
         ok2 = (j < l_cap) & (e2 <= hi2)
         return ok1 | ok2, jnp.where(ok1, e1, e2)
@@ -434,7 +440,9 @@ def _parallel_select(pos_l, pos_s, n, *, min_size: int, desired_size: int,
         final = jnp.full_like(x0, -1)
         for _ in range(probe_iters):
             short = (n - y) <= m  # short tail -> single final chunk
-            hit, e = step_from(y)
+            # short lanes resolve to n-1 regardless of hit/e, so their
+            # window lookups are dead; done lanes never consume again
+            hit, e = step_from(y, use=~done & ~short)
             at_eof = y >= n - M   # forced cut would land at n-1
             now_term = short | (~hit & at_eof)
             resolved = ~done & (short | hit | at_eof)
@@ -447,8 +455,9 @@ def _parallel_select(pos_l, pos_s, n, *, min_size: int, desired_size: int,
             done = done | resolved
             # closed-form jump over the candidate-free gap: earliest start
             # that could see the next strict/loose candidate in-window
-            qs = pos_s[jnp.minimum(ss_s(y + (m - 1)), s_cap - 1)]
-            ql = pos_l[jnp.minimum(ss_l(y + (d - 1)), l_cap - 1)]
+            # (consumed only by lanes still jumping, i.e. ~done post-update)
+            qs = pos_s[jnp.minimum(ss_s(y + (m - 1), ~done), s_cap - 1)]
+            ql = pos_l[jnp.minimum(ss_l(y + (d - 1), ~done), l_cap - 1)]
             target = jnp.minimum(jnp.minimum(qs - (d - 2), ql - (M - 2)),
                                  n - M)
             steps = jnp.maximum(
@@ -466,8 +475,11 @@ def _parallel_select(pos_l, pos_s, n, *, min_size: int, desired_size: int,
     node_un = unres[:l_cap]
     # next node index: the final cut is itself a loose candidate unless
     # terminal (exact match by construction)
+    # unresolved nodes carry final=-1 (garbage query) and already flag the
+    # row via the unresolved chain, so they don't accumulate overflow here
     nxt0 = jnp.where(
-        node_term, TERM, ss_l(node_final).astype(jnp.int32))
+        node_term, TERM,
+        ss_l(node_final, ~node_term & ~node_un).astype(jnp.int32))
     emit0 = node_j + 1  # j forced cuts + 1 candidate/terminal cut
     # TERM self-loop emits nothing
     nxt0 = jnp.concatenate([nxt0, TERM[None]])
@@ -489,7 +501,7 @@ def _parallel_select(pos_l, pos_s, n, *, min_size: int, desired_size: int,
     h0_final = final[l_cap]
     h0_un = unres[l_cap]
     b1 = jnp.where(
-        h0_term, TERM, ss_l(h0_final).astype(jnp.int32))
+        h0_term, TERM, ss_l(h0_final, ~h0_term & ~h0_un).astype(jnp.int32))
     h0_emit = h0_j + 1
     total = h0_emit + emits[-1][b1]
     row_unres = h0_un | uns[-1][b1]

@@ -282,6 +282,16 @@ def _build_probe_fn(mesh: Mesh, axis: str, capacity: int, max_probes: int,
 
             def body(st):
                 keys, values, race, exh, r = st
+                # INVARIANT: `_f` (found) is discarded because a retried
+                # query is provably a NEW key — its round-1 probe walked
+                # the chain to the contested EMPTY slot without a key
+                # match, and the slot it lost was taken by a *different*
+                # key (race requires stored != allq).  Re-probing can only
+                # pass that now-occupied slot and continue to the next
+                # empty one; it can never discover a match for this key.
+                # If local_probe's semantics ever change (e.g. deletions
+                # leaving tombstones a retry could match), `_f` must be
+                # ORed into `found` instead of dropped.
                 keys, values, _f, race2, exh2 = attempt(keys, values, race)
                 return keys, values, race2, exh | exh2, r + 1
 

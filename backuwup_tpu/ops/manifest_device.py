@@ -136,6 +136,32 @@ def class_caps(params: CDCParams, total_bytes: int,
     return tuple(caps)
 
 
+def _chunk_meta(packed: jnp.ndarray, row_len: int):
+    """Packed cut rows -> flat per-chunk (abs offset, length, valid).
+
+    Derived entirely on device.  Rows whose scan/select overflowed carry
+    garbage cut lists; the host re-runs those rows on the oracle anyway,
+    so their chunks are masked out here — otherwise one bad row would
+    consume digest capacities and could flag the WHOLE batch overflowed.
+    """
+    B = packed.shape[0]
+    cut_cap = packed.shape[1] - 2
+    n_cuts = packed[:, 1]  # (B,)
+    ends = packed[:, 2:]   # (B, cut_cap) inclusive ends, -1 padded
+    offs = jnp.concatenate(
+        [jnp.zeros((B, 1), dtype=ends.dtype), ends[:, :-1] + 1], axis=1)
+    lens = ends - offs + 1
+    valid = (jnp.arange(cut_cap, dtype=jnp.int32)[None, :]
+             < n_cuts[:, None])  # (B, cut_cap)
+    row_ok = packed[:, 0] == 0  # (B,)
+    valid = valid & row_ok[:, None]
+    lens = jnp.where(valid, lens, 0)
+    # absolute byte offset of each chunk in the flattened batch buffer
+    row_base = (jnp.arange(B, dtype=jnp.int32) * row_len + _HALO)[:, None]
+    return ((row_base + offs).reshape(-1), lens.reshape(-1),
+            valid.reshape(-1))
+
+
 @functools.partial(jax.jit, static_argnames=(
     "min_size", "desired_size", "max_size", "mask_s", "mask_l",
     "s_cap", "l_cap", "cut_cap", "fused", "classes", "caps",
@@ -160,21 +186,7 @@ def scan_digest_batch(buf_d: jnp.ndarray, nv_b: jnp.ndarray, *,
         buf_d, nv_b, min_size=min_size, desired_size=desired_size,
         max_size=max_size, mask_s=mask_s, mask_l=mask_l,
         s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap, fused=fused)
-
-    # --- chunk meta from cut lists, on device ------------------------------
-    n_cuts = packed[:, 1]  # (B,)
-    ends = packed[:, 2:]   # (B, cut_cap) inclusive ends, -1 padded
-    offs = jnp.concatenate(
-        [jnp.zeros((B, 1), dtype=ends.dtype), ends[:, :-1] + 1], axis=1)
-    lens = ends - offs + 1
-    valid = (jnp.arange(cut_cap, dtype=jnp.int32)[None, :]
-             < n_cuts[:, None])  # (B, cut_cap)
-    lens = jnp.where(valid, lens, 0)
-    # absolute byte offset of each chunk in the flattened batch buffer
-    row_base = (jnp.arange(B, dtype=jnp.int32) * row_len + _HALO)[:, None]
-    abs_offs = (row_base + offs).reshape(-1)
-    flat_lens = lens.reshape(-1)
-    flat_valid = valid.reshape(-1)
+    abs_offs, flat_lens, flat_valid = _chunk_meta(packed, row_len)
     total = B * cut_cap
 
     leaves = (flat_lens + (CHUNK_LEN - 1)) // CHUNK_LEN
@@ -214,4 +226,57 @@ def scan_digest_batch(buf_d: jnp.ndarray, nv_b: jnp.ndarray, *,
         cv = digest_padded(tile, ln, L=Lc, pallas=pallas_digest)  # (cap, 8)
         acc = acc.at[idx].set(cv, mode="drop")
     ovf = jnp.sum(carry.astype(jnp.int32))[None]  # terminus overflow only
+    return packed, acc, ovf
+
+
+@functools.lru_cache(maxsize=64)
+def tier_plan(params: CDCParams, total_bytes: int,
+              n_rows: int) -> Tuple[Tuple[int, int], ...]:
+    """((leaf_span, chunk_cap), ...) tree tiers for the leaf-pool digest.
+
+    Chunk-count expectations come from the same analytic length
+    histogram as :func:`class_caps`, re-binned onto the 2-3 geometric
+    tier spans (tree work is ~1/16 of leaf work, so coarse spans cost
+    a few percent where the payload-level class tiles could not afford
+    them).  Class bins that straddle a tier edge only blur the capacity
+    estimate — overflow still cascades and, at the terminus, falls back
+    bit-exactly.
+    """
+    from .digest_pool import tier_caps, tier_spans
+
+    mean_len, fracs = _length_histogram(params)
+    classes = class_leaf_sizes(params)
+    spans = tier_spans(-(-params.max_size // CHUNK_LEN))
+    return tier_caps(spans, tuple(zip(classes, fracs)),
+                     total_bytes / max(mean_len, 1.0), n_rows)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "min_size", "desired_size", "max_size", "mask_s", "mask_l",
+    "s_cap", "l_cap", "cut_cap", "fused", "leaf_cap", "tiers",
+    "pallas_digest"))
+def scan_digest_batch_pool(buf_d: jnp.ndarray, nv_b: jnp.ndarray, *,
+                           min_size: int, desired_size: int, max_size: int,
+                           mask_s: int, mask_l: int, s_cap: int, l_cap: int,
+                           cut_cap: int, fused: bool, leaf_cap: int,
+                           tiers: Tuple[Tuple[int, int], ...],
+                           pallas_digest: bool = False):
+    """Leaf-pool twin of :func:`scan_digest_batch` — same contract, but
+    the digest stage is ONE flat leaf scan + 2-3 tiny tree tiles
+    (:func:`backuwup_tpu.ops.digest_pool.pool_digest`) instead of ~12
+    per-class gather+digest pipelines.  Selected by ``DevicePipeline``'s
+    runtime parity ladder; bit-identical output either way.
+    """
+    from .digest_pool import pool_digest
+
+    row_len = buf_d.shape[1]
+    packed = scan_select_batch(
+        buf_d, nv_b, min_size=min_size, desired_size=desired_size,
+        max_size=max_size, mask_s=mask_s, mask_l=mask_l,
+        s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap, fused=fused)
+    abs_offs, flat_lens, flat_valid = _chunk_meta(packed, row_len)
+    flat = jnp.pad(buf_d.reshape(-1), (0, CHUNK_LEN))
+    acc, ovf = pool_digest(
+        flat, abs_offs, jnp.where(flat_valid, flat_lens, 0),
+        leaf_cap=leaf_cap, tiers=tiers, pallas=pallas_digest)
     return packed, acc, ovf

@@ -190,9 +190,14 @@ class DevicePipeline:
         self.b_bucket = b_bucket
         self._nv_cache: dict = {}
         from .blake3_tpu import pallas_digest_available
+        from .digest_pool import pool_digest_available
         from .scan_fused import fused_scan_available
         self.fused = fused_scan_available()
         self.pallas_digest = pallas_digest_available()
+        # leaf-pool digest stage: one flat leaf scan + tiny tree tiles
+        # instead of ~12 per-class pipelines; parity-gated on the live
+        # runtime, class tiles remain the fallback
+        self.pool_digest = pool_digest_available(self.pallas_digest)
 
     # --- scan + select (device) -------------------------------------------
 
@@ -410,7 +415,10 @@ class DevicePipeline:
         candidate capacity overflowed re-chunks on the CPU oracle; a batch
         whose class capacities overflowed re-runs on the host-tiled path.
         """
-        from .manifest_device import class_caps, class_leaf_sizes, scan_digest_batch
+        from .digest_pool import leaf_capacity
+        from .manifest_device import (class_caps, class_leaf_sizes,
+                                      scan_digest_batch,
+                                      scan_digest_batch_pool, tier_plan)
 
         p = self.params
         classes = class_leaf_sizes(p)
@@ -419,19 +427,30 @@ class DevicePipeline:
 
         def dispatch():
             for buf_d, nv in it:
+                B = int(buf_d.shape[0])
                 padded = int(buf_d.shape[1]) - _HALO
                 s_cap, l_cap, cut_cap = self._caps(padded)
-                caps = class_caps(p, int(buf_d.shape[0]) * padded,
-                                  int(buf_d.shape[0]))
                 with tracing.span("pipeline.scan_digest_dispatch"):
-                    packed, acc, ovf = scan_digest_batch(
-                        buf_d, self._nv_device(nv),
-                        min_size=p.min_size, desired_size=p.desired_size,
-                        max_size=p.max_size, mask_s=p.mask_s,
-                        mask_l=p.mask_l, s_cap=s_cap, l_cap=l_cap,
-                        cut_cap=cut_cap, fused=self.fused,
-                        classes=classes, caps=caps,
-                        pallas_digest=self.pallas_digest)
+                    if self.pool_digest:
+                        packed, acc, ovf = scan_digest_batch_pool(
+                            buf_d, self._nv_device(nv),
+                            min_size=p.min_size, desired_size=p.desired_size,
+                            max_size=p.max_size, mask_s=p.mask_s,
+                            mask_l=p.mask_l, s_cap=s_cap, l_cap=l_cap,
+                            cut_cap=cut_cap, fused=self.fused,
+                            leaf_cap=leaf_capacity(B * padded, B * cut_cap),
+                            tiers=tier_plan(p, B * padded, B),
+                            pallas_digest=self.pallas_digest)
+                    else:
+                        packed, acc, ovf = scan_digest_batch(
+                            buf_d, self._nv_device(nv),
+                            min_size=p.min_size, desired_size=p.desired_size,
+                            max_size=p.max_size, mask_s=p.mask_s,
+                            mask_l=p.mask_l, s_cap=s_cap, l_cap=l_cap,
+                            cut_cap=cut_cap, fused=self.fused,
+                            classes=classes,
+                            caps=class_caps(p, B * padded, B),
+                            pallas_digest=self.pallas_digest)
                 for a in (packed, acc, ovf):
                     _async_to_host(a)
                 pending.append((buf_d, nv, cut_cap, packed, acc, ovf))

@@ -1,9 +1,10 @@
 """First-run CLI: recovery-phrase UX (``client/src/ui/cli.rs``).
 
 Fresh setup prints the recovery phrase derived from the root secret
-(``cli.rs:55-77``, the BIP39-mnemonic analog); the restore path prompts for
-an existing phrase and rebuilds the identity deterministically
-(``cli.rs:26-51`` + ``identity.rs:46-69``).
+(``cli.rs:55-77``, which prints a BIP39 mnemonic — here both a 24-word
+mnemonic from the embedded wordlist and the compact base32 form); the
+restore path prompts for an existing phrase in either form and rebuilds
+the identity deterministically (``cli.rs:26-51`` + ``identity.rs:46-69``).
 """
 
 from __future__ import annotations
@@ -11,7 +12,7 @@ from __future__ import annotations
 import sys
 from typing import Callable, Optional
 
-from ..crypto import phrase_to_secret, secret_to_phrase
+from ..crypto import parse_recovery, secret_to_phrase, secret_to_words
 
 BANNER = """\
 Welcome to backuwup!
@@ -25,21 +26,26 @@ disaster — write it down and keep it somewhere safe and offline.
 def print_recovery_phrase(root_secret: bytes, out=None) -> None:
     out = out or sys.stdout
     print(BANNER, file=out)
+    words = secret_to_words(root_secret).split()
+    for i in range(0, len(words), 6):
+        print("    " + " ".join(f"{w:<8}" for w in words[i:i + 6]).rstrip(),
+              file=out)
+    print("\nor, equivalently, the compact form:\n", file=out)
     print("    " + secret_to_phrase(root_secret), file=out)
-    print("\nAnyone with this phrase can read your backups; never share it.",
-          file=out)
+    print("\nEither form restores your identity. Anyone with this phrase "
+          "can read your backups; never share it.", file=out)
 
 
 def prompt_restore_phrase(input_fn: Optional[Callable[[str], str]] = None,
                           out=None) -> bytes:
     """Interactive phrase entry with validation loop (cli.rs:26-51);
-    returns the decoded root secret."""
+    accepts the 24-word or the base32 form, returns the root secret."""
     input_fn = input_fn or input
     out = out or sys.stdout
     while True:
-        phrase = input_fn("Enter your recovery phrase: ")
+        phrase = input_fn("Enter your recovery phrase (words or code): ")
         try:
-            return phrase_to_secret(phrase)
+            return parse_recovery(phrase)
         except ValueError as e:
             print(f"That phrase is not valid ({e}); try again.", file=out)
 

@@ -114,9 +114,13 @@ def main() -> None:
     cpu_digests = Blake3Numpy().digest_batch(
         [parity_bytes[o:o + l] for o, l in cpu_chunks])
     ext = np.concatenate([np.zeros(_HALO, dtype=np.uint8), parity])
+    # strict_overflow: an overflow/unresolved row silently re-chunks on the
+    # CPU oracle, which would make this gate compare oracle to oracle and
+    # pass vacuously exactly when the device path misbehaves.
     (tpu_chunks, tpu_digests), = next(iter(pipeline.manifest_segments_device(
         [(jnp.asarray(ext.reshape(1, -1)),
-          np.full(1, len(parity_bytes), dtype=np.int32))])))
+          np.full(1, len(parity_bytes), dtype=np.int32))],
+        strict_overflow=True)))
     tpu_digest_bytes = [bytes(d) for d in tpu_digests]
     if tpu_chunks != cpu_chunks or tpu_digest_bytes != cpu_digests:
         print(json.dumps({"metric": "chunk+hash parity FAILED", "value": 0.0,

@@ -284,7 +284,7 @@ def config3_incremental(pipeline: DevicePipeline, params: CDCParams,
                               np.frombuffer(blob, dtype=np.uint8)])
         (res,), = pipeline.manifest_segments_device(
             [(jnp.asarray(ext.reshape(1, -1)),
-              np.full(1, sub, dtype=np.int32))])
+              np.full(1, sub, dtype=np.int32))], strict_overflow=True)
         _check(res, blob, params, "#3")
         dev_sub.append(res)
     dev_sa = {bytes(d) for d in dev_sub[0][1]}
@@ -326,7 +326,8 @@ def config4_large_stream(log: Callable) -> Dict:
     ext = np.concatenate([np.zeros(_HALO, dtype=np.uint8),
                           np.frombuffer(data, dtype=np.uint8)])
     (dev_sub,), = pipeline.manifest_segments_device(
-        [(jnp.asarray(ext.reshape(1, -1)), np.full(1, sub, dtype=np.int32))])
+        [(jnp.asarray(ext.reshape(1, -1)), np.full(1, sub, dtype=np.int32))],
+        strict_overflow=True)
     _check(dev_sub, data, params, "#4")
     log(f"config#4 large-stream(64KiB): {done * seg_mib / 1024:.1f} GiB in "
         f"{dt:.2f}s = {mibs:.1f} MiB/s ({n_chunks} chunks)")

@@ -5,9 +5,12 @@ import pytest
 from backuwup_tpu.crypto import (
     KeyManager,
     hkdf_derive,
+    parse_recovery,
     phrase_to_secret,
     secret_to_phrase,
+    secret_to_words,
     verify_signature,
+    words_to_secret,
 )
 
 
@@ -69,3 +72,53 @@ def test_generate_restores_from_phrase():
     km = KeyManager.generate()
     restored = KeyManager.from_secret(phrase_to_secret(secret_to_phrase(km.root_secret)))
     assert restored.client_id == km.client_id
+
+
+def test_wordlist_shape():
+    from backuwup_tpu.wordlist import WORD_INDEX, WORDS
+    assert len(WORDS) == 2048
+    assert len(WORD_INDEX) == 2048  # no duplicates
+    assert all(w.isalpha() and w.islower() and 3 <= len(w) <= 8
+               for w in WORDS)
+
+
+def test_word_phrase_round_trip():
+    for secret in (bytes(range(32)), b"\x00" * 32, b"\xff" * 32,
+                   KeyManager.generate().root_secret):
+        words = secret_to_words(secret)
+        assert len(words.split()) == 24
+        assert words_to_secret(words) == secret
+        # forgiveness: case, dashes, 4-char prefixes where unambiguous
+        assert words_to_secret(words.upper().replace(" ", " - ")) == secret
+
+
+def test_word_phrase_prefix_tolerance():
+    secret = bytes(range(32))
+    words = secret_to_words(secret).split()
+    from backuwup_tpu.wordlist import WORDS
+    trunc = []
+    for w in words:
+        pre = w[:4]
+        trunc.append(pre if sum(x.startswith(pre) for x in WORDS) == 1 else w)
+    assert words_to_secret(" ".join(trunc)) == secret
+
+
+def test_word_phrase_rejects_typos():
+    secret = bytes(range(32))
+    words = secret_to_words(secret).split()
+    swapped = [words[1], words[0]] + words[2:]
+    if swapped != words:
+        with pytest.raises(ValueError):
+            words_to_secret(" ".join(swapped))
+    with pytest.raises(ValueError):
+        words_to_secret(" ".join(words[:-1]))
+    with pytest.raises(ValueError):
+        words_to_secret(" ".join(["zzzzz"] + words[1:]))
+
+
+def test_parse_recovery_accepts_both_forms():
+    secret = KeyManager.generate().root_secret
+    assert parse_recovery(secret_to_phrase(secret)) == secret
+    assert parse_recovery(secret_to_words(secret)) == secret
+    with pytest.raises(ValueError):
+        parse_recovery("not a recovery phrase at all")

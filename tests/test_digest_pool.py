@@ -1,0 +1,171 @@
+"""Leaf-pool digest must be bit-identical to the BLAKE3 spec oracle.
+
+Covers the round-5 digest-stage redesign (`ops/digest_pool.py`): one flat
+leaf scan + tiered tree reduction replacing the ~12 per-class digest
+pipelines of `scan_digest_batch`.  The reference hashes chunks serially
+on the CPU (`dir_packer.rs:285-311`); bit-exact parity with the spec
+implementation is the correctness bar for both designs.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from backuwup_tpu.ops import cdc_cpu
+from backuwup_tpu.ops.blake3_cpu import Blake3Numpy, blake3_hash
+from backuwup_tpu.ops.cdc_tpu import _HALO
+from backuwup_tpu.ops.digest_pool import (
+    leaf_capacity,
+    pool_digest,
+    pool_digest_available,
+    tier_caps,
+    tier_spans,
+)
+from backuwup_tpu.ops.gear import CDCParams
+from backuwup_tpu.ops.manifest_device import (
+    scan_digest_batch_pool,
+    tier_plan,
+)
+from backuwup_tpu.ops.pipeline import DevicePipeline
+
+SMALL = CDCParams.from_desired(4096)
+
+
+def _digests_of(acc: np.ndarray):
+    return [np.ascontiguousarray(row.astype("<u4")).tobytes() for row in acc]
+
+
+def _run_pool(flat, offs, lens, C, tiers=None, leaf_cap=None, **kw):
+    offs_a = np.zeros(C, np.int32)
+    lens_a = np.zeros(C, np.int32)
+    offs_a[:len(offs)] = offs
+    lens_a[:len(lens)] = lens
+    if tiers is None:
+        tiers = tuple((s, C) for s in tier_spans(128))
+    if leaf_cap is None:
+        leaf_cap = leaf_capacity(len(flat), C)
+    flat_p = np.concatenate([flat, np.zeros(1024, np.uint8)])
+    acc, ovf = pool_digest(jnp.asarray(flat_p), jnp.asarray(offs_a),
+                           jnp.asarray(lens_a), leaf_cap=leaf_cap,
+                           tiers=tiers, **kw)
+    return np.asarray(acc), int(np.asarray(ovf)[0])
+
+
+@pytest.mark.parametrize("pallas_kw", [
+    {"pallas": False},
+    {"pallas": True, "interpret": True},
+], ids=["xla", "pallas-interpret"])
+def test_pool_digest_matches_oracle(pallas_kw):
+    rng = np.random.default_rng(5)
+    flat = rng.integers(0, 256, 512 * 1024, dtype=np.uint8)
+    # every structural edge: sub-block, block boundary, leaf boundary,
+    # multi-leaf, power-of-two and odd leaf counts, unused slots
+    lens = [1, 2, 63, 64, 65, 1023, 1024, 1025, 2048, 2049, 5 * 1024,
+            17 * 1024 + 7, 64 * 1024, 100_000]
+    offs, cur = [], 0
+    for l in lens:
+        offs.append(cur)
+        cur += l
+    acc, ovf = _run_pool(flat, offs, lens, C=20, **pallas_kw)
+    assert ovf == 0
+    got = _digests_of(acc)
+    for i, l in enumerate(lens):
+        assert got[i] == blake3_hash(flat[offs[i]:offs[i] + l].tobytes()), \
+            f"len {l}"
+
+
+def test_pool_digest_overlapping_and_shuffled_spans():
+    """Chunks may share bytes (dedup re-reads) and arrive in any order."""
+    rng = np.random.default_rng(6)
+    flat = rng.integers(0, 256, 256 * 1024, dtype=np.uint8)
+    spans = [(0, 10_000), (5_000, 10_000), (5_000, 3_000), (200_000, 50_000),
+             (1, 1), (0, 256 * 1024)]
+    rng.shuffle(spans)
+    offs = [o for o, _ in spans]
+    lens = [l for _, l in spans]
+    acc, ovf = _run_pool(flat, offs, lens, C=8,
+                         tiers=tuple((s, 8) for s in tier_spans(256)))
+    assert ovf == 0
+    got = _digests_of(acc)
+    for i, (o, l) in enumerate(spans):
+        assert got[i] == blake3_hash(flat[o:o + l].tobytes())
+
+
+def test_pool_digest_tier_cascade_and_overflow():
+    rng = np.random.default_rng(8)
+    flat = rng.integers(0, 256, 64 * 1024, dtype=np.uint8)
+    lens = [4096] * 8  # 4 leaves each
+    offs = [i * 4096 for i in range(8)]
+    # tier 0 holds only 4 of the 8; the rest must cascade up and still
+    # digest correctly in the wider tier
+    tiers = ((4, 4), (8, 8))
+    acc, ovf = _run_pool(flat, offs, lens, C=8, tiers=tiers)
+    assert ovf == 0
+    got = _digests_of(acc)
+    for i in range(8):
+        assert got[i] == blake3_hash(flat[offs[i]:offs[i] + 4096].tobytes())
+    # terminus overflow: capacity 4+2 < 8 chunks -> flagged, not silent
+    acc, ovf = _run_pool(flat, offs, lens, C=8, tiers=((4, 4), (8, 2)))
+    assert ovf > 0
+
+
+def test_pool_digest_leaf_cap_shortfall_flagged():
+    flat = np.zeros(32 * 1024, np.uint8)
+    acc, ovf = _run_pool(flat, [0, 8192], [8192, 8192], C=4,
+                         tiers=((8, 4), (16, 4)), leaf_cap=8)
+    assert ovf > 0  # 16 leaves needed, 8 lanes available
+
+
+def test_tier_plan_shapes():
+    spans = tier_spans(3072)
+    assert spans[-1] == 3072 and len(spans) <= 3
+    assert all(a < b for a, b in zip(spans, spans[1:]))
+    plan = tier_plan(SMALL, 4 << 20, 4)
+    assert plan[-1][0] == SMALL.max_size // 1024
+    assert all(c % 4 == 0 for _, c in plan)
+    assert plan[-1][1] > 0
+    assert leaf_capacity(1 << 20, 64) >= (1 << 20) // 1024 + 64
+
+
+def test_pool_gate_runs():
+    # on the test runtime (CPU mesh) the XLA pool path must pass its gate
+    assert pool_digest_available(False) is True
+
+
+def test_scan_digest_batch_pool_matches_oracle():
+    P = 65536
+    rng = np.random.default_rng(13)
+    sizes = [P, 30_000, 0, 1, 5000]
+    rows = [rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            for n in sizes]
+    buf = np.zeros((len(rows), _HALO + P), dtype=np.uint8)
+    nv = np.zeros(len(rows), dtype=np.int32)
+    for r, d in enumerate(rows):
+        buf[r, _HALO:_HALO + len(d)] = np.frombuffer(d, dtype=np.uint8)
+        nv[r] = len(d)
+    pipe = DevicePipeline(SMALL)
+    s_cap, l_cap, cut_cap = pipe._caps(P)
+    packed, acc, ovf = scan_digest_batch_pool(
+        jnp.asarray(buf), jnp.asarray(nv), min_size=SMALL.min_size,
+        desired_size=SMALL.desired_size, max_size=SMALL.max_size,
+        mask_s=SMALL.mask_s, mask_l=SMALL.mask_l,
+        s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap, fused=False,
+        leaf_cap=leaf_capacity(len(rows) * P, len(rows) * cut_cap),
+        tiers=tier_plan(SMALL, len(rows) * P, len(rows)))
+    packed = np.asarray(packed)
+    acc = np.asarray(acc)
+    assert not np.asarray(ovf).any()
+    dig8 = np.ascontiguousarray(acc.astype("<u4")).view(np.uint8).reshape(
+        len(rows), cut_cap, 32)
+    for r, data in enumerate(rows):
+        ref_chunks = cdc_cpu.chunk_stream(data, SMALL)
+        ref_digests = Blake3Numpy().digest_batch(
+            [data[o:o + l] for o, l in ref_chunks])
+        assert packed[r, 0] == 0
+        n_cuts = int(packed[r, 1])
+        ends = packed[r, 2:2 + n_cuts].astype(np.int64)
+        offs = np.concatenate([[0], ends[:-1] + 1])
+        assert list(zip(offs.tolist(),
+                        (ends - offs + 1).tolist())) == ref_chunks
+        assert [bytes(d) for d in dig8[r, :n_cuts]] == ref_digests

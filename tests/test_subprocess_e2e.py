@@ -1,0 +1,154 @@
+"""Process-level smoke test: REAL `python -m backuwup_tpu` processes.
+
+The reference's manual two-client local test (docs/src/client.md:41-45,
+mirrored in this repo's docs/client.md walkthrough) driven end-to-end
+against actual OS processes and loopback sockets: one coordination
+server + two clients, matched backup, then a restore after data loss —
+everything through the same entry points a user runs, not in-process
+wiring (which tests/test_integration.py already covers).
+
+Accelerator-free: the subprocesses run with JAX_PLATFORMS=cpu and the
+clients use the host backend for the tiny corpora here.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(args, cwd=REPO):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # no virtual mesh: plain 1-core client procs
+    return subprocess.Popen(
+        [sys.executable, "-m", "backuwup_tpu", *args], cwd=cwd, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        bufsize=1)
+
+
+def _wait_line(proc, needle: str, timeout: float = 120) -> str:
+    deadline = time.monotonic() + timeout
+    lines = []
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError(
+                f"process exited before {needle!r}:\n{''.join(lines)}")
+        lines.append(line)
+        if needle in line:
+            return line
+    raise AssertionError(f"timeout waiting for {needle!r}:\n{''.join(lines)}")
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(15)
+
+
+def _ws_url(dash_line: str) -> str:
+    # "... dashboard at http://127.0.0.1:PORT"
+    return dash_line.rsplit("at ", 1)[1].strip().rstrip("/") + "/ws"
+
+
+async def _drive(ws_url_a: str, ws_url_b: str, src_a: Path):
+    """Start backups on both clients over their dashboards' WS command
+    channel, await completion, then wipe A's data and restore it."""
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        async with session.ws_connect(ws_url_a) as wa, \
+                session.ws_connect(ws_url_b) as wb:
+            await wa.send_str(json.dumps({"command": "start_backup"}))
+            await wb.send_str(json.dumps({"command": "start_backup"}))
+
+            async def finish(ws):
+                while True:
+                    ev = json.loads(await ws.receive_str())
+                    assert ev["kind"] != "error", ev
+                    if ev["kind"] == "backup_finished":
+                        return ev["payload"]["snapshot"]
+
+            snap_a = await finish(wa)
+            snap_b = await finish(wb)
+            assert len(bytes.fromhex(snap_a)) == 32
+            assert len(bytes.fromhex(snap_b)) == 32
+
+            # disaster on A: lose the data, restore from peer B
+            for p in sorted(src_a.rglob("*"), reverse=True):
+                p.unlink() if p.is_file() else p.rmdir()
+            await wa.send_str(json.dumps({"command": "start_restore"}))
+            while True:
+                ev = json.loads(await wa.receive_str())
+                assert ev["kind"] != "error", ev
+                if ev["kind"] == "restore_finished":
+                    return
+
+
+def test_two_process_backup_restore(tmp_path):
+    import asyncio
+    import random
+
+    rng = random.Random(7)
+    src_a = tmp_path / "a_src"
+    src_b = tmp_path / "b_src"
+    files_a = {}
+    for d, tag in ((src_a, "a"), (src_b, "b")):
+        (d / "sub").mkdir(parents=True)
+        data = {"f.bin": rng.randbytes(300_000),
+                "sub/nested.txt": f"hello {tag}\n".encode()}
+        for rel, blob in data.items():
+            (d / rel).write_bytes(blob)
+        if tag == "a":
+            files_a = data
+
+    port = _free_port()
+    server = _spawn(["server", "--bind", f"127.0.0.1:{port}",
+                     "--db", str(tmp_path / "srv.db")])
+    clients = []
+    try:
+        _wait_line(server, f"listening on 127.0.0.1:{port}")
+        ws_urls = []
+        for name, src in (("a", src_a), ("b", src_b)):
+            c = _spawn(["client", "--non-interactive",
+                        "--server-addr", f"127.0.0.1:{port}",
+                        "--config-dir", str(tmp_path / name / "cfg"),
+                        "--data-dir", str(tmp_path / name / "data"),
+                        "--backup-path", str(src),
+                        "--ui-bind", "127.0.0.1:0"])
+            clients.append(c)
+            ws_urls.append(_ws_url(_wait_line(c, "dashboard at")))
+
+        # the dashboard itself must be served by the real process
+        with urllib.request.urlopen(
+                ws_urls[0][:-3], timeout=10) as resp:
+            assert b"backuwup" in resp.read()
+
+        asyncio.run(asyncio.wait_for(
+            _drive(ws_urls[0], ws_urls[1], src_a), 180))
+
+        # byte-identical restore
+        for rel, blob in files_a.items():
+            assert (src_a / rel).read_bytes() == blob, rel
+    finally:
+        for c in clients:
+            _stop(c)
+        _stop(server)
