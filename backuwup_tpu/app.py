@@ -65,10 +65,13 @@ class ClientApp:
         self.node = P2PNode(self.keys, self.store, self.server)
         self.node.on_transport_request = self._accept_peer_data
         self.node.on_restore_request = self._serve_restore
+        self.node.on_audit_request = self._serve_audit
         self.server.on_backup_matched = self._backup_matched
+        self.server.on_audit_due = self._audit_due
         self.engine = Engine(self.keys, self.store, self.server, self.node,
                              backend=backend, messenger=self.messenger,
                              dedup_mesh=dedup_mesh)
+        self._audit_task: Optional[asyncio.Task] = None
 
     @classmethod
     def from_phrase(cls, phrase: str, **kwargs) -> "ClientApp":
@@ -91,9 +94,18 @@ class ClientApp:
         await self.server.login()
         self.server.start_ws()
         await asyncio.wait_for(self.server.ws_connected.wait(), 10)
+        self._audit_task = asyncio.create_task(
+            self.engine.audit_scheduler())
         self.messenger.log("connected to coordination server")
 
     async def stop(self) -> None:
+        if self._audit_task is not None:
+            self._audit_task.cancel()
+            try:
+                await self._audit_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._audit_task = None
         await self.server.close()
         self.store.close()
 
@@ -119,6 +131,19 @@ class ClientApp:
         self.messenger.log(
             f"served {sent} files back to {bytes(source).hex()[:8]}")
 
+    async def _serve_audit(self, source: bytes, transport) -> None:
+        answered = await self.node.serve_audit(source, transport,
+                                               self.engine.backend)
+        self.messenger.log(
+            f"answered {answered} audit challenges for "
+            f"{bytes(source).hex()[:8]}")
+
+    async def _audit_due(self, msg: wire.AuditDue) -> None:
+        """Server nudge: another client's audit of this peer failed."""
+        self.engine.note_audit_due(msg.peer_id)
+        self.messenger.log(
+            f"audit of {bytes(msg.peer_id).hex()[:8]} requested by server")
+
     # --- commands (ws_dispatcher.rs:16-23) ---------------------------------
 
     async def backup(self, root: Optional[Path] = None) -> bytes:
@@ -130,6 +155,10 @@ class ClientApp:
         except Exception as e:
             self.messenger.log(f"backup failed: {e}")
             raise
+
+    async def audit(self) -> dict:
+        """Run one verifier round over every peer whose audit is due."""
+        return await self.engine.run_audit_round()
 
     async def restore(self, dest: Optional[Path] = None) -> Path:
         self.messenger.restore_started()

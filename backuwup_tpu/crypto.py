@@ -27,14 +27,25 @@ import hmac
 import os
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers import Cipher
-from cryptography.hazmat.primitives.ciphers.algorithms import ChaCha20
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers import Cipher
+    from cryptography.hazmat.primitives.ciphers.algorithms import ChaCha20
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+except ModuleNotFoundError:  # containers without the wheel: libcrypto shim
+    from .utils.compat_crypto import (
+        Cipher,
+        ChaCha20,
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+        HKDF,
+        hashes,
+        serialization,
+    )
 
 ROOT_SECRET_LEN = 32
 KEY_LEN = 32
@@ -173,12 +184,24 @@ def secret_to_words(secret: bytes) -> str:
                     for i in range(_WORD_COUNT))
 
 
-def _resolve_word(token: str) -> int:
+def _resolve_word(token: str, truncated: bool = False) -> int:
     """Word -> index; exact match, else unique >=4-char prefix (error
-    tolerance for truncated transcriptions, BIP39's 4-letter convention)."""
+    tolerance for truncated transcriptions, BIP39's 4-letter convention).
+
+    In a *truncated* phrase (one where some other token only resolved as
+    a prefix) an exact match that is also a proper prefix of longer list
+    words — ``bell`` vs ``belly``, ``cat`` vs ``catalog`` — is ambiguous:
+    the transcriber may have cut either word down to it.  Full phrases
+    keep resolving such words exactly, so round-trips never regress.
+    """
     from .wordlist import WORD_INDEX, WORDS
     idx = WORD_INDEX.get(token)
     if idx is not None:
+        if truncated and any(w != token and w.startswith(token)
+                             for w in WORDS):
+            raise ValueError(
+                f"ambiguous word {token!r}: in a truncated phrase it may "
+                "stand for itself or a longer word; spell it out in full")
         return idx
     if len(token) >= 4:
         hits = [i for i, w in enumerate(WORDS) if w.startswith(token)]
@@ -186,21 +209,31 @@ def _resolve_word(token: str) -> int:
             return hits[0]
         if len(hits) > 1:
             raise ValueError(f"ambiguous word prefix: {token!r}")
-    raise ValueError(f"unknown recovery word: {token!r}")
+    raise ValueError(
+        f"unknown recovery word: {token!r} — not in this client's embedded "
+        "wordlist; a BIP39 phrase from a different wallet or language "
+        "cannot be imported here")
 
 
 def words_to_secret(phrase: str) -> bytes:
     """Inverse of :func:`secret_to_words`; raises ValueError on typos."""
+    from .wordlist import WORD_INDEX
     tokens = phrase.strip().lower().replace("-", " ").replace(",", " ").split()
     if len(tokens) != _WORD_COUNT:
         raise ValueError(f"word phrase must have {_WORD_COUNT} words "
                          f"(got {len(tokens)})")
+    # truncation-style entry: at least one token is not a full list word,
+    # so exact-but-prefix words elsewhere in the phrase become ambiguous
+    truncated = any(tok not in WORD_INDEX for tok in tokens)
     v = 0
     for i, tok in enumerate(tokens):
-        v |= _resolve_word(tok) << (_WORD_BITS * i)
+        v |= _resolve_word(tok, truncated=truncated) << (_WORD_BITS * i)
     secret = (v & ((1 << 256) - 1)).to_bytes(32, "big")
     if v >> 256 != _check_tag(secret)[4]:
-        raise ValueError("word phrase checksum mismatch")
+        raise ValueError(
+            "word phrase checksum mismatch: this is not a phrase this "
+            "client generated — a valid BIP39 phrase from another wallet "
+            "uses a different checksum layout and cannot be imported")
     return secret
 
 

@@ -59,6 +59,21 @@ MAX_P2P_MESSAGE_SIZE = 8 * MiB
 P2P_ENVELOPE_OVERHEAD = 4 * KiB
 PACKFILE_WIRE_MAX = MAX_P2P_MESSAGE_SIZE - P2P_ENVELOPE_OVERHEAD
 
+# --- storage attestation (no reference equivalent; docs/audit.md) -----------
+AUDIT_CHALLENGES_PER_PACKFILE = 16  # precomputed table entries per packfile
+AUDIT_WINDOW_BYTES = 64 * KiB  # sampled window length (clamped to file size)
+AUDIT_SAMPLES_PER_ROUND = 8  # challenges issued per peer per audit round
+AUDIT_MAX_CHALLENGES_PER_MSG = 256  # prover-side cap on one CHALLENGE body
+AUDIT_INTERVAL_S = 6 * 3600.0  # healthy-peer re-audit cadence
+AUDIT_RETRY_BASE_S = 60.0  # first retry delay after a miss/failure
+AUDIT_BACKOFF_CAP_S = 24 * 3600.0  # exponential-backoff ceiling
+AUDIT_DEMOTE_MISSES = 3  # consecutive offline windows before demotion
+AUDIT_DEMOTE_FAILURES = 1  # confirmed bad/missing proofs before demotion
+AUDIT_PROOF_TIMEOUT_S = 15.0  # verifier wait for the PROOF body
+AUDIT_SERVE_MIN_INTERVAL_S = 5.0  # prover-side per-peer rate limit
+AUDIT_SERVER_BLOCK_FAILURES = 2  # distinct failing verifiers to block matches
+AUDIT_REPORT_WINDOW_S = 24 * 3600.0  # server aggregation window
+
 # --- server-side TTLs (reference server/src/client_auth_manager.rs:17-20) ---
 AUTH_CHALLENGE_TTL_S = 30.0
 SESSION_TTL_S = 24 * 3600.0

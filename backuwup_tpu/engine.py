@@ -34,11 +34,20 @@ from pathlib import Path
 from typing import Dict, Optional
 
 from . import defaults, wire
+from .audit import (
+    AuditResult,
+    build_challenge_table,
+    check_proofs,
+    record_fail,
+    record_miss,
+    record_pass,
+    select_challenges,
+)
 from .crypto import KeyManager
 from .net.client import NoBackups, ServerClient, ServerError
 from .net.p2p import P2PError, P2PNode, Receiver, RestoreFilesWriter, Transport
 from .ops.backend import ChunkerBackend, select_backend
-from .snapshot.blob_index import BlobIndex
+from .snapshot.blob_index import BlobIndex, ChallengeTable
 from .snapshot.packer import DirPacker
 from .snapshot.packfile import PackfileReader, PackfileWriter
 from .store import EVENT_BACKUP, EVENT_RESTORE_REQUEST, Store
@@ -108,6 +117,7 @@ class Engine:
         self.messenger = messenger
         self.index = BlobIndex(keys, self._index_dir())
         self.index.load()
+        self.challenge_tables = ChallengeTable(keys, store.challenge_dir())
         # with a mesh attached, dedup decisions run batched on the sharded
         # HBM table; BlobIndex stays the persisted authority + parity
         # oracle.  On an accelerator backend the mesh is attached by
@@ -264,6 +274,19 @@ class Engine:
     def _on_packfile_threadsafe(self, loop):
         def cb(pid, path, hashes, size):
             self.index.finalize_packfile(pid, hashes)
+            # Precompute the audit challenge table while the plaintext
+            # packfile is still local (it is unlinked after the peer's
+            # ack) — hashed in one device batch alongside packing.  A
+            # failure here degrades auditing, never the backup itself.
+            try:
+                if not self.challenge_tables.has(pid):
+                    self.challenge_tables.save(
+                        pid, build_challenge_table(
+                            self.backend, path.read_bytes(),
+                            count=defaults.AUDIT_CHALLENGES_PER_PACKFILE))
+            except Exception as e:
+                self._log(f"challenge table for {bytes(pid).hex()[:8]}"
+                          f" failed: {e}")
             self.orchestrator.bytes_written += size
             self.orchestrator.adjust_buffer(size)
             self._progress(bytes_on_disk=self.orchestrator.bytes_written)
@@ -325,6 +348,7 @@ class Engine:
                     break
                 path.unlink()  # delete only after ack (send.rs:277-289)
                 self.store.add_peer_transmitted(peer_id, size)
+                self.store.record_placement(pid, peer_id, size)
                 orch.bytes_sent += size
                 orch.adjust_buffer(-size)
                 peer_free -= size
@@ -412,6 +436,110 @@ class Engine:
         t = orch.active_transports.pop(bytes(peer_id), None)
         if t is not None:
             await t.close()
+
+    # --- storage audits (verifier side, audit/) ----------------------------
+
+    def note_audit_due(self, peer_id: bytes) -> None:
+        """Pull a peer's next audit forward (server AuditDue push)."""
+        self.store.mark_audit_due(peer_id)
+
+    async def audit_peer(self, peer_id: bytes,
+                         now: Optional[float] = None) -> Optional[AuditResult]:
+        """One challenge–response audit round against one peer.
+
+        Selection burns the challenge cursor before anything is sent, the
+        proof must echo our sequence number under this session's nonce
+        (replays from older sessions/rounds are rejected), and the outcome
+        lands in the ledger + the coordination server.  Returns None when
+        the peer has nothing auditable left (tables consumed).
+        """
+        peer_id = bytes(peer_id)
+        now = time.time() if now is None else now
+        challenges, expected = select_challenges(
+            self.store, self.challenge_tables, peer_id)
+        if not challenges:
+            from dataclasses import replace
+            st = self.store.get_audit_state(peer_id)
+            self.store.put_audit_state(replace(
+                st, next_due=now + defaults.AUDIT_INTERVAL_S))
+            return None
+        try:
+            t = await self.node.connect(peer_id, wire.RequestType.AUDIT,
+                                        timeout=10.0)
+        except (P2PError, ServerError, OSError, asyncio.TimeoutError) as e:
+            st = record_miss(self.store, peer_id, now=now)
+            self._audit_event(peer_id, "miss", str(e), st)
+            return AuditResult(passed=False, checked=0,
+                              detail=f"unreachable: {e}")
+        try:
+            seq = t.seq
+            t.seq += 1
+            await t.send_body(wire.P2PBody(
+                kind=wire.P2PBodyKind.CHALLENGE,
+                header=wire.P2PHeader(sequence_number=seq,
+                                      session_nonce=t.session_nonce),
+                challenges=tuple(challenges)))
+            reply = await t.recv_body(defaults.AUDIT_PROOF_TIMEOUT_S)
+        except P2PError as e:
+            st = record_miss(self.store, peer_id, now=now)
+            self._audit_event(peer_id, "miss", str(e), st)
+            return AuditResult(passed=False, checked=0,
+                              detail=f"no proof: {e}")
+        finally:
+            await t.close()
+        if reply.kind != wire.P2PBodyKind.PROOF \
+                or reply.header.sequence_number != seq:
+            result = AuditResult(passed=False, checked=len(challenges),
+                                 detail="bad or replayed proof body")
+        else:
+            result = check_proofs(challenges, expected, reply.proofs)
+        if result.passed:
+            st = record_pass(self.store, peer_id, now=now)
+        else:
+            st = record_fail(self.store, peer_id, result.detail, now=now)
+        self._audit_event(peer_id, "pass" if result.passed else "fail",
+                          result.detail, st)
+        try:
+            await self.server.audit_report(peer_id, result.passed,
+                                           result.detail)
+        except Exception as e:
+            self._log(f"audit report upload failed: {e}")
+        return result
+
+    async def run_audit_round(self, now: Optional[float] = None) -> Dict:
+        """Audit every peer whose ledger says it is due."""
+        now = time.time() if now is None else now
+        results: Dict[bytes, AuditResult] = {}
+        for peer in self.store.audit_due_peers(now):
+            res = await self.audit_peer(peer, now=now)
+            if res is not None:
+                results[bytes(peer)] = res
+        return results
+
+    async def audit_scheduler(self, poll_s: float = 30.0) -> None:
+        """Background verifier loop; skips polls while a backup/restore
+        holds the engine so audits never contend for the transports."""
+        while True:
+            await asyncio.sleep(poll_s)
+            if self._exclusive.locked():
+                continue
+            try:
+                await self.run_audit_round()
+            except Exception as e:  # keep the loop alive across bad rounds
+                self._log(f"audit round failed: {e}")
+
+    def _audit_event(self, peer_id: bytes, outcome: str, detail: str,
+                     state) -> None:
+        hexid = bytes(peer_id).hex()
+        msg = f"audit {outcome} for peer {hexid[:8]}"
+        if detail:
+            msg += f": {detail}"
+        if state.demoted:
+            msg += " (peer demoted)"
+        self._log(msg)
+        if self.messenger is not None:
+            self.messenger.audit(hexid, outcome, detail=detail,
+                                 demoted=state.demoted)
 
     # --- restore (backup/mod.rs:117-192) -----------------------------------
 

@@ -122,6 +122,7 @@ class ServerClient:
         self.on_backup_matched: Optional[Callable] = None
         self.on_incoming_p2p: Optional[Callable] = None
         self.on_finalize_p2p: Optional[Callable] = None
+        self.on_audit_due: Optional[Callable] = None
         self.ws_connected = asyncio.Event()
 
     async def _session(self) -> aiohttp.ClientSession:
@@ -245,6 +246,13 @@ class ServerClient:
                 session_token=t, source_client_id=source,
                 destination_ip_address=addr)))
 
+    async def audit_report(self, peer_id: bytes, passed: bool,
+                           detail: str = "") -> None:
+        await self._with_login(lambda t: self._post(
+            "/audit/report", wire.AuditReport(
+                session_token=t, peer_id=bytes(peer_id), passed=passed,
+                detail=detail)))
+
     # --- push channel (net_server/mod.rs) ----------------------------------
 
     def start_ws(self) -> asyncio.Task:
@@ -290,3 +298,5 @@ class ServerClient:
             self._spawn_handler(self.on_incoming_p2p(msg))
         elif isinstance(msg, wire.FinalizeP2PConnection) and self.on_finalize_p2p:
             self._spawn_handler(self.on_finalize_p2p(msg))
+        elif isinstance(msg, wire.AuditDue) and self.on_audit_due:
+            self._spawn_handler(self.on_audit_due(msg))

@@ -27,7 +27,11 @@ import time
 from typing import Callable, Dict, Optional
 
 import numpy as np
-import websockets
+
+try:
+    import websockets
+except ModuleNotFoundError:  # containers without the wheel: aiohttp shim
+    from ..utils import ws_compat as websockets
 
 from .. import defaults, wire
 from ..crypto import KeyManager, verify_signature
@@ -35,6 +39,7 @@ from ..store import Store
 
 PURPOSE_TRANSPORT = wire.RequestType.TRANSPORT
 PURPOSE_RESTORE = wire.RequestType.RESTORE_ALL
+PURPOSE_AUDIT = wire.RequestType.AUDIT
 
 
 class P2PError(Exception):
@@ -156,6 +161,26 @@ class Transport:
             raise P2PError(f"send/ack failed for seq {seq}: {e}") from e
         finally:
             self._acks.pop(seq, None)
+
+    async def send_body(self, body: wire.P2PBody) -> None:
+        """Fire one signed non-FILE body (audit challenge/proof exchange —
+        correlation is by echoed sequence number, not per-frame acks)."""
+        try:
+            await asyncio.wait_for(self.ws.send(_sign_body(self.keys, body)),
+                                   defaults.PACKFILE_SEND_TIMEOUT_S)
+        except (asyncio.TimeoutError, websockets.ConnectionClosed) as e:
+            raise P2PError(f"send failed: {e}") from e
+
+    async def recv_body(self, timeout: float) -> wire.P2PBody:
+        """Next verified non-ACK body from the peer (None sentinel on close
+        becomes an error: callers always expect a concrete body)."""
+        try:
+            body = await asyncio.wait_for(self._recv_queue.get(), timeout)
+        except asyncio.TimeoutError as e:
+            raise P2PError("timed out waiting for peer body") from e
+        if body is None:
+            raise P2PError("connection closed while waiting for peer body")
+        return body
 
     async def close(self) -> None:
         if self._ack_task is not None:
@@ -282,6 +307,7 @@ class P2PNode:
         self._finalize_waiters: Dict[bytes, asyncio.Queue] = {}
         self.on_transport_request: Optional[Callable] = None
         self.on_restore_request: Optional[Callable] = None
+        self.on_audit_request: Optional[Callable] = None
         server_client.on_incoming_p2p = self._handle_incoming
         server_client.on_finalize_p2p = self._handle_finalize
 
@@ -371,6 +397,9 @@ class P2PNode:
                 elif request_type == wire.RequestType.RESTORE_ALL:
                     if self.on_restore_request is not None:
                         await self.on_restore_request(source, transport)
+                elif request_type == wire.RequestType.AUDIT:
+                    if self.on_audit_request is not None:
+                        await self.on_audit_request(source, transport)
             finally:
                 done.set()
                 await transport.close()
@@ -392,3 +421,37 @@ class P2PNode:
             await transport.send_data(data, kind, file_id)
             sent += 1
         return sent
+
+    # --- audit serving (prover side of the storage attestation) ------------
+
+    async def serve_audit(self, peer_id: bytes, transport: Transport,
+                          backend) -> int:
+        """Answer one storage-audit challenge batch from ``peer_id``.
+
+        The verifier opens an AUDIT-purpose connection, sends a single
+        CHALLENGE body, and expects one PROOF body echoing its sequence
+        number.  Per-peer rate limiting mirrors ``serve_restore`` so a
+        hostile verifier cannot turn us into a free hashing oracle.
+        """
+        from ..audit.prover import compute_proofs  # local: avoids cycle
+
+        peer_hex = bytes(peer_id).hex()
+        last = self.store.last_event_time(f"audit_served:{peer_hex}")
+        if last is not None and \
+                time.time() - last < defaults.AUDIT_SERVE_MIN_INTERVAL_S:
+            raise P2PError("audit request throttled")
+        self.store.add_event(f"audit_served:{peer_hex}", {})
+        body = await transport.recv_body(defaults.AUDIT_PROOF_TIMEOUT_S)
+        if body.kind != wire.P2PBodyKind.CHALLENGE:
+            raise P2PError("expected a CHALLENGE body on an audit connection")
+        if len(body.challenges) > defaults.AUDIT_MAX_CHALLENGES_PER_MSG:
+            raise P2PError("too many challenges in one message")
+        proofs = compute_proofs(self.store, backend, peer_id, body.challenges)
+        reply = wire.P2PBody(
+            kind=wire.P2PBodyKind.PROOF,
+            header=wire.P2PHeader(
+                sequence_number=body.header.sequence_number,
+                session_nonce=transport.session_nonce),
+            proofs=tuple(proofs))
+        await transport.send_body(reply)
+        return len(proofs)

@@ -51,6 +51,15 @@ CREATE TABLE IF NOT EXISTS snapshots (
 );
 CREATE INDEX IF NOT EXISTS snapshots_by_client
     ON snapshots (client_pubkey, timestamp);
+CREATE TABLE IF NOT EXISTS audit_reports (
+    reporter BLOB NOT NULL,
+    peer BLOB NOT NULL,
+    passed INTEGER NOT NULL,
+    detail TEXT NOT NULL DEFAULT '',
+    timestamp REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS audit_reports_by_peer
+    ON audit_reports (peer, timestamp);
 """
 
 
@@ -129,6 +138,36 @@ class ServerDB:
             "SELECT DISTINCT destination FROM peer_backups WHERE source = ?",
             (pubkey,)).fetchall()
         return [bytes(r[0]) for r in rows]
+
+    def get_clients_storing_on(self, pubkey: bytes) -> list:
+        """Sources with data on ``pubkey`` (the reverse negotiation edge)."""
+        rows = self._db.execute(
+            "SELECT DISTINCT source FROM peer_backups WHERE destination = ?",
+            (pubkey,)).fetchall()
+        return [bytes(r[0]) for r in rows]
+
+    def save_audit_report(self, reporter: bytes, peer: bytes, passed: bool,
+                          detail: str) -> None:
+        self._db.execute(
+            "INSERT INTO audit_reports (reporter, peer, passed, detail,"
+            " timestamp) VALUES (?, ?, ?, ?, ?)",
+            (reporter, peer, int(passed), detail, time.time()))
+        self._db.commit()
+
+    def audit_failing_reporters(self, peer: bytes,
+                                window_s: float) -> int:
+        """Distinct reporters whose LATEST report on ``peer`` within the
+        window is a failure.  A later pass from the same reporter clears
+        its vote, so a recovered peer re-enters matchmaking without any
+        server-side state surgery."""
+        rows = self._db.execute(
+            "SELECT reporter, passed FROM audit_reports"
+            " WHERE peer = ? AND timestamp >= ? ORDER BY timestamp",
+            (peer, time.time() - window_s)).fetchall()
+        latest: Dict[bytes, int] = {}
+        for reporter, passed in rows:
+            latest[bytes(reporter)] = passed
+        return sum(1 for passed in latest.values() if not passed)
 
 
 class AuthManager:
@@ -232,6 +271,12 @@ class StorageQueue:
                 candidate, cand_remaining, cand_expires = entry
                 if candidate == bytes(client_id):
                     continue  # self-match discarded
+                if self.db.audit_failing_reporters(
+                        candidate, defaults.AUDIT_REPORT_WINDOW_S) \
+                        >= defaults.AUDIT_SERVER_BLOCK_FAILURES:
+                    # Independently reported as failing storage audits:
+                    # drop its queued request rather than hand it new data.
+                    continue
                 match = min(remaining, cand_remaining)
                 # Record the negotiation FIRST, then push: a client must
                 # never learn of a match the server does not persist (a
@@ -426,6 +471,22 @@ class CoordinationServer:
             raise self._err(wire.ErrorKind.DESTINATION_UNREACHABLE)
         return self._ok()
 
+    async def audit_report(self, request):
+        """Record one client's audit verdict on a peer; on failure, nudge
+        every other client storing on that peer to audit it soon (the
+        server never sees data, only verdicts — SURVEY.md §1 holds)."""
+        msg = await self._parse(request, wire.AuditReport)
+        client = self._session(msg)
+        peer = bytes(msg.peer_id)
+        self.db.save_audit_report(client, peer, bool(msg.passed),
+                                  msg.detail or "")
+        if not msg.passed:
+            for source in self.db.get_clients_storing_on(peer):
+                if source not in (client, peer):
+                    await self.connections.notify(
+                        source, wire.AuditDue(peer_id=peer))
+        return self._ok()
+
     async def ws(self, request):
         token = request.headers.get("Authorization")
         try:
@@ -460,6 +521,7 @@ class CoordinationServer:
             web.post("/backups/restore", self.backup_restore),
             web.post("/p2p/connection/begin", self.p2p_begin),
             web.post("/p2p/connection/confirm", self.p2p_confirm),
+            web.post("/audit/report", self.audit_report),
             web.get("/ws", self.ws),
         ])
         return app

@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..utils.jax_compat import shard_map
 from .. import defaults
 from .cdc_cpu import cuts_to_chunks, select_cuts
 from .cdc_cpu import gear_hashes as gear_hashes_np
@@ -722,7 +723,7 @@ def make_sharded_scanner(mesh: Mesh, axis: str = "data", *,
         return (abs_widx[None], words_l[safe][None], words_s[safe][None],
                 nz_words[None])
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(axis), P(), P(), P()),
         out_specs=(P(axis), P(axis), P(axis), P(axis)),

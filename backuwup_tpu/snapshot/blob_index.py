@@ -22,23 +22,105 @@ from __future__ import annotations
 
 import os
 import re
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Set
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ModuleNotFoundError:  # containers without the wheel: libcrypto shim
+    from ..utils.compat_crypto import AESGCM
 
 from .. import defaults
 from ..crypto import KeyManager
 from ..utils.serialization import Reader, Writer
-from ..wire import BLOB_HASH_LEN, PACKFILE_ID_LEN
+from ..wire import AUDIT_NONCE_LEN, BLOB_HASH_LEN, PACKFILE_ID_LEN
 
 INDEX_KEY_INFO = b"index"
+CHALLENGE_KEY_INFO = b"audit"
 _NAME_RE = re.compile(r"^\d{6}$")
 
 
 def index_file_name(counter: int) -> str:
     """Zero-padded numbering (file_utils.rs:55-57)."""
     return f"{counter:06d}"
+
+
+@dataclass(frozen=True)
+class ChallengeEntry:
+    """One precomputed audit probe: expected digest of a sampled window.
+
+    ``digest = blake3(nonce || packfile_bytes[offset : offset+length])`` —
+    the verifier records it at pack time (while the plaintext packfile is
+    still on disk) because the local copy is deleted once a peer acks it.
+    """
+
+    offset: int
+    length: int
+    nonce: bytes  # AUDIT_NONCE_LEN; keys the digest so peers can't precompute
+    digest: bytes  # BLOB_HASH_LEN
+
+
+class ChallengeTable:
+    """Write-once encrypted audit challenge tables, one file per packfile.
+
+    Same persistence idiom as the blob index: AES-GCM with a positionally
+    bound nonce — here the 12-byte packfile id itself, which is unique per
+    table, and the file is never rewritten, so the (key, nonce) pair
+    encrypts exactly one plaintext.  Key = HKDF(backup secret, b"audit"),
+    distinct from the index key so audit state and dedup state are
+    cryptographically separated.
+    """
+
+    def __init__(self, keys: KeyManager, table_dir: Path):
+        self.table_dir = Path(table_dir)
+        self._key = keys.derive_backup_key(CHALLENGE_KEY_INFO)
+
+    def path(self, packfile_id: bytes) -> Path:
+        return self.table_dir / bytes(packfile_id).hex()
+
+    def has(self, packfile_id: bytes) -> bool:
+        return self.path(packfile_id).is_file()
+
+    def save(self, packfile_id: bytes,
+             entries: Iterable[ChallengeEntry]) -> Path:
+        pid = bytes(packfile_id)
+        if len(pid) != PACKFILE_ID_LEN:
+            raise ValueError("bad packfile id length")
+        path = self.path(pid)
+        if path.exists():
+            raise FileExistsError(
+                f"challenge table for {pid.hex()} already written"
+                " (tables are write-once; rewriting would reuse the nonce)")
+        entries = list(entries)
+        w = Writer()
+        w.u64(len(entries))
+        for e in entries:
+            w.u64(e.offset)
+            w.u64(e.length)
+            w.fixed(bytes(e.nonce))
+            w.fixed(bytes(e.digest))
+        ct = AESGCM(self._key).encrypt(pid, w.take(), None)
+        self.table_dir.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_bytes(ct)
+        os.replace(tmp, path)
+        return path
+
+    def load(self, packfile_id: bytes) -> List[ChallengeEntry]:
+        pid = bytes(packfile_id)
+        plain = AESGCM(self._key).decrypt(
+            pid, self.path(pid).read_bytes(), None)
+        r = Reader(plain)
+        out = []
+        for _ in range(r.u64()):
+            offset = r.u64()
+            length = r.u64()
+            nonce = r.fixed(AUDIT_NONCE_LEN)
+            digest = r.fixed(BLOB_HASH_LEN)
+            out.append(ChallengeEntry(offset, length, nonce, digest))
+        r.expect_end()
+        return out
 
 
 class BlobIndex:

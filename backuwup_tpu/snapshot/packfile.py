@@ -32,7 +32,10 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Callable, Dict, List, Optional
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ModuleNotFoundError:  # containers without the wheel: libcrypto shim
+    from ..utils.compat_crypto import AESGCM
 
 from .. import defaults
 from ..crypto import KeyManager

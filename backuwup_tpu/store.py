@@ -49,6 +49,25 @@ CREATE TABLE IF NOT EXISTS log (
     event TEXT NOT NULL,
     data TEXT NOT NULL
 );
+CREATE TABLE IF NOT EXISTS placements (
+    packfile_id BLOB NOT NULL,
+    peer BLOB NOT NULL,
+    size INTEGER NOT NULL,
+    sent_at REAL NOT NULL,
+    PRIMARY KEY (packfile_id, peer)
+);
+CREATE TABLE IF NOT EXISTS audit_ledger (
+    peer BLOB PRIMARY KEY,
+    passes INTEGER NOT NULL DEFAULT 0,
+    failures INTEGER NOT NULL DEFAULT 0,
+    misses INTEGER NOT NULL DEFAULT 0,
+    consecutive_failures INTEGER NOT NULL DEFAULT 0,
+    consecutive_misses INTEGER NOT NULL DEFAULT 0,
+    demoted INTEGER NOT NULL DEFAULT 0,
+    last_result TEXT NOT NULL DEFAULT '',
+    last_audit REAL NOT NULL DEFAULT 0,
+    next_due REAL NOT NULL DEFAULT 0
+);
 """
 
 EVENT_BACKUP = "backup"
@@ -63,6 +82,22 @@ def config_dir() -> Path:
 def data_dir() -> Path:
     d = os.environ.get("DATA_DIR")
     return Path(d) if d else Path.home() / ".backuwup" / "data"
+
+
+@dataclass(frozen=True)
+class AuditState:
+    """One peer's row in the audit ledger (no reference equivalent)."""
+
+    peer: bytes
+    passes: int = 0
+    failures: int = 0
+    misses: int = 0
+    consecutive_failures: int = 0
+    consecutive_misses: int = 0
+    demoted: bool = False
+    last_result: str = ""
+    last_audit: float = 0.0
+    next_due: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -189,6 +224,12 @@ class Store:
         d.mkdir(parents=True, exist_ok=True)
         return d
 
+    def challenge_dir(self) -> Path:
+        """Encrypted per-packfile audit challenge tables (docs/audit.md)."""
+        d = self.data_base / "challenges"
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
     # --- peers ledger (config/peers.rs) ------------------------------------
 
     def add_peer_negotiated(self, pubkey: bytes, amount: int,
@@ -233,10 +274,115 @@ class Store:
 
     def find_peers_with_storage(self) -> list:
         """Peers ordered by free (negotiated - transmitted) storage, most
-        first (peers.rs:176-193)."""
-        peers = [p for p in self.list_peers() if p.free_storage > 0]
+        first (peers.rs:176-193).  Peers the audit ledger demoted are
+        excluded entirely: a peer proven to drop data must not receive more.
+        """
+        demoted = self.demoted_peers()
+        peers = [p for p in self.list_peers()
+                 if p.free_storage > 0 and p.pubkey not in demoted]
         peers.sort(key=lambda p: p.free_storage, reverse=True)
         return peers
+
+    # --- packfile placements (verifier's who-holds-what map) ----------------
+
+    def record_placement(self, packfile_id: bytes, peer: bytes, size: int,
+                         now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO placements (packfile_id, peer, size, sent_at)"
+                " VALUES (?, ?, ?, ?)"
+                " ON CONFLICT(packfile_id, peer) DO NOTHING",
+                (bytes(packfile_id), bytes(peer), int(size), now))
+            self._db.commit()
+
+    def placements_for_peer(self, peer: bytes) -> list:
+        """[(packfile_id, size)] held by ``peer``, oldest placement first."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT packfile_id, size FROM placements WHERE peer = ?"
+                " ORDER BY sent_at", (bytes(peer),)).fetchall()
+        return [(bytes(r[0]), int(r[1])) for r in rows]
+
+    def peers_with_placements(self) -> list:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT DISTINCT peer FROM placements").fetchall()
+        return [bytes(r[0]) for r in rows]
+
+    # --- audit ledger (docs/audit.md; no reference equivalent) --------------
+
+    def get_audit_state(self, peer: bytes) -> "AuditState":
+        with self._lock:
+            row = self._db.execute(
+                "SELECT peer, passes, failures, misses, consecutive_failures,"
+                " consecutive_misses, demoted, last_result, last_audit,"
+                " next_due FROM audit_ledger WHERE peer = ?",
+                (bytes(peer),)).fetchone()
+        if row is None:
+            return AuditState(peer=bytes(peer))
+        return AuditState(bytes(row[0]), *row[1:6], bool(row[6]), *row[7:])
+
+    def put_audit_state(self, state: "AuditState") -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO audit_ledger (peer, passes, failures, misses,"
+                " consecutive_failures, consecutive_misses, demoted,"
+                " last_result, last_audit, next_due)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)"
+                " ON CONFLICT(peer) DO UPDATE SET"
+                " passes = excluded.passes, failures = excluded.failures,"
+                " misses = excluded.misses,"
+                " consecutive_failures = excluded.consecutive_failures,"
+                " consecutive_misses = excluded.consecutive_misses,"
+                " demoted = excluded.demoted,"
+                " last_result = excluded.last_result,"
+                " last_audit = excluded.last_audit,"
+                " next_due = excluded.next_due",
+                (state.peer, state.passes, state.failures, state.misses,
+                 state.consecutive_failures, state.consecutive_misses,
+                 int(state.demoted), state.last_result, state.last_audit,
+                 state.next_due))
+            self._db.commit()
+
+    def demoted_peers(self) -> set:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT peer FROM audit_ledger WHERE demoted = 1").fetchall()
+        return {bytes(r[0]) for r in rows}
+
+    def audit_due_peers(self, now: Optional[float] = None) -> list:
+        """Peers holding placements whose next audit is due (next_due <=
+        now), never-audited peers (no ledger row) first."""
+        now = time.time() if now is None else now
+        due = []
+        for peer in self.peers_with_placements():
+            st = self.get_audit_state(peer)
+            if st.next_due <= now:
+                due.append((st.next_due, peer))
+        due.sort(key=lambda t: t[0])
+        return [p for _, p in due]
+
+    def mark_audit_due(self, peer: bytes,
+                       now: Optional[float] = None) -> None:
+        """Pull a peer's next audit forward to *now* (AuditDue push)."""
+        now = time.time() if now is None else now
+        st = self.get_audit_state(peer)
+        if st.next_due > now:
+            self.put_audit_state(
+                AuditState(st.peer, st.passes, st.failures, st.misses,
+                           st.consecutive_failures, st.consecutive_misses,
+                           st.demoted, st.last_result, st.last_audit, now))
+
+    # --- audit challenge cursor (single-use table entries) ------------------
+
+    def get_audit_cursor(self, packfile_id: bytes) -> int:
+        v = self._get(f"audit_cursor:{bytes(packfile_id).hex()}")
+        return 0 if v is None else int(v)
+
+    def set_audit_cursor(self, packfile_id: bytes, value: int) -> None:
+        self._set(f"audit_cursor:{bytes(packfile_id).hex()}",
+                  str(int(value)).encode())
 
     # --- event log (config/log.rs) -----------------------------------------
 

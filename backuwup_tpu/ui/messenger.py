@@ -94,6 +94,13 @@ class Messenger:
     def config(self, cfg: dict) -> None:
         self._emit(StatusEvent("config", cfg))
 
+    def audit(self, peer: str, outcome: str, detail: str = "",
+              demoted: bool = False) -> None:
+        """Storage-audit verdict frame (outcome: pass | fail | miss)."""
+        self._emit(StatusEvent("audit", {"peer": peer, "outcome": outcome,
+                                         "detail": detail,
+                                         "demoted": demoted}))
+
     def error(self, text: str) -> None:
         self._emit(StatusEvent("error", {"text": text}))
 

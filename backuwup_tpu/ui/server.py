@@ -113,8 +113,21 @@ class UIServer:
                 self.messenger.peers([
                     {"id": p.pubkey.hex(), "negotiated": p.bytes_negotiated,
                      "transmitted": p.bytes_transmitted,
-                     "received": p.bytes_received}
+                     "received": p.bytes_received,
+                     "audit": self._peer_audit_health(p.pubkey)}
                     for p in self.app.store.list_peers()])
+
+    def _peer_audit_health(self, pubkey: bytes) -> dict:
+        st = self.app.store.get_audit_state(pubkey)
+        if st.last_audit == 0.0 and not (st.passes or st.failures
+                                         or st.misses):
+            health = "unaudited"
+        elif st.demoted:
+            health = "demoted"
+        else:
+            health = st.last_result or "unaudited"
+        return {"health": health, "passes": st.passes,
+                "failures": st.failures, "misses": st.misses}
 
     # --- routes ------------------------------------------------------------
 
@@ -173,6 +186,8 @@ class UIServer:
             asyncio.create_task(self._run_guarded(self.app.backup()))
         elif command == "start_restore":
             asyncio.create_task(self._run_guarded(self.app.restore()))
+        elif command == "start_audit":
+            asyncio.create_task(self._run_guarded(self.app.audit()))
         else:
             self.messenger.error(f"unknown UI command: {command!r}")
 

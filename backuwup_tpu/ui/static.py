@@ -59,6 +59,7 @@ input[type=text] { background:#0d1117; color:var(--text); border:1px solid
       <button class="secondary" id="save">Save path</button>
       <button id="backup">Back up</button>
       <button class="secondary" id="restore">Restore</button>
+      <button class="secondary" id="audit">Audit peers</button>
     </div>
     <div class="bar"><div id="pbar"></div></div>
     <div class="row" style="justify-content:space-between">
@@ -129,15 +130,25 @@ function onProgress(p) {
   }
   $("backup").disabled = $("restore").disabled = !!p.running;
 }
+function auditLabel(a) {
+  if (!a) return "-";
+  const tally = a.passes + "/" + a.failures + "/" + a.misses;
+  return a.health + " (" + tally + ")";
+}
 function onPeers(peers) {
   const t = $("peers");
   t.innerHTML = "<tr><td>peer</td><td>negotiated</td><td>sent</td>" +
-                "<td>stored for them</td></tr>";
+                "<td>stored for them</td><td>audit p/f/m</td></tr>";
   for (const p of peers) {
     const r = t.insertRow();
     for (const v of [p.id.slice(0, 12), fmtBytes(p.negotiated),
                      fmtBytes(p.transmitted), fmtBytes(p.received)])
       r.insertCell().textContent = v;
+    const c = r.insertCell();
+    c.textContent = auditLabel(p.audit);
+    if (p.audit && (p.audit.health === "demoted" ||
+                    p.audit.health.startsWith("fail")))
+      c.className = "err";
   }
 }
 function onEvent(ev) {
@@ -151,6 +162,13 @@ function onEvent(ev) {
     logLine("backup finished: " + ev.payload.snapshot);
   else if (ev.kind === "restore_started") logLine("restore started");
   else if (ev.kind === "restore_finished") logLine("restore finished");
+  else if (ev.kind === "audit") {
+    const a = ev.payload;
+    logLine("audit " + a.outcome + " for " + a.peer.slice(0, 12) +
+            (a.detail ? ": " + a.detail : "") +
+            (a.demoted ? " [demoted]" : ""),
+            a.outcome === "pass" ? undefined : "err");
+  }
   else if (ev.kind === "error") logLine(ev.payload.text, "err");
 }
 function connect() {
@@ -166,6 +184,7 @@ function connect() {
 $("save").onclick = () => send("config", {backup_path: $("path").value});
 $("backup").onclick = () => send("start_backup");
 $("restore").onclick = () => send("start_restore");
+$("audit").onclick = () => send("start_audit");
 connect();
 </script>
 </body>

@@ -1,0 +1,25 @@
+"""Version-portable accessors for JAX APIs that moved between releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to
+``jax.shard_map``; this repo targets the graduated name but must also run
+on the pinned 0.4.x toolchain where only the experimental path exists.
+The keyword signature (``mesh=``, ``in_specs=``, ``out_specs=``) is
+identical in both, so call sites just import ``shard_map`` from here.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kwargs):
+        # The experimental version predates replication rules for
+        # while_loop (the dedup probe's retry loop); the graduated API
+        # checks those fine, so only the fallback relaxes the check.
+        kwargs.setdefault("check_rep", False)
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)

@@ -28,6 +28,7 @@ PACKFILE_ID_LEN = 12  # doubles as the packfile header AES-GCM nonce
 SESSION_TOKEN_LEN = 16
 TRANSPORT_NONCE_LEN = 16
 CHALLENGE_NONCE_LEN = 32
+AUDIT_NONCE_LEN = 16  # per-window keyed-digest nonce (storage attestation)
 
 
 def _check(name: str, value: bytes, length: int) -> bytes:
@@ -290,6 +291,20 @@ class BackupDone(JsonMessage):
                      "snapshot_hash": BLOB_HASH_LEN}
 
 
+@dataclass
+class AuditReport(JsonMessage):
+    """Client -> server: outcome of one storage-attestation round against
+    ``peer_id`` (no reference equivalent; see docs/audit.md).  The server
+    aggregates reports across verifiers to adjust matchmaking."""
+
+    session_token: bytes
+    peer_id: bytes
+    passed: bool
+    detail: str = ""
+    _bytes_fields = {"session_token": SESSION_TOKEN_LEN,
+                     "peer_id": CLIENT_ID_LEN}
+
+
 # server -> client HTTP responses (reference shared/src/server_message.rs:9-54)
 
 @dataclass
@@ -392,13 +407,24 @@ class FinalizeP2PConnection(JsonMessage):
     _bytes_fields = {"destination_client_id": CLIENT_ID_LEN}
 
 
+@dataclass
+class AuditDue(JsonMessage):
+    """Server -> client WS scheduling nudge: another verifier reported
+    ``peer_id`` failing its storage audit — clients holding data there
+    should audit it soon rather than waiting out their normal interval."""
+
+    peer_id: bytes
+    _bytes_fields = {"peer_id": CLIENT_ID_LEN}
+
+
 # --- p2p data-plane messages (reference shared/src/p2p_message.rs) ----------
 
 class RequestType(IntEnum):
-    """p2p_message.rs:36-39."""
+    """p2p_message.rs:36-39 (AUDIT added for storage attestation)."""
 
     TRANSPORT = 0
     RESTORE_ALL = 1
+    AUDIT = 2
 
 
 class FileInfoKind(IntEnum):
@@ -428,12 +454,75 @@ class P2PBodyKind(IntEnum):
     REQUEST = 0
     FILE = 1
     ACK = 2
+    CHALLENGE = 3  # storage-attestation challenge batch
+    PROOF = 4  # storage-attestation proof batch
+
+
+class ProofStatus(IntEnum):
+    """Per-window prover outcome inside a PROOF body."""
+
+    OK = 0
+    MISSING = 1  # prover no longer holds the packfile at all
+    SHORT = 2  # packfile present but shorter than the challenged window
+
+
+@dataclass(frozen=True)
+class StorageChallenge:
+    """One random-window audit challenge: prove possession of
+    ``packfile_id[offset:offset+length]`` by returning
+    blake3(nonce || window-bytes)."""
+
+    packfile_id: bytes
+    offset: int
+    length: int
+    nonce: bytes
+
+    def __post_init__(self) -> None:
+        _check("challenge packfile id", self.packfile_id, PACKFILE_ID_LEN)
+        _check("challenge nonce", self.nonce, AUDIT_NONCE_LEN)
+
+    def encode(self, w: Writer) -> None:
+        w.fixed(self.packfile_id)
+        w.u64(self.offset)
+        w.u64(self.length)
+        w.fixed(self.nonce)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "StorageChallenge":
+        return cls(packfile_id=r.fixed(PACKFILE_ID_LEN), offset=r.u64(),
+                   length=r.u64(), nonce=r.fixed(AUDIT_NONCE_LEN))
+
+
+@dataclass(frozen=True)
+class StorageProof:
+    """The prover's answer to one :class:`StorageChallenge` (digest is
+    all-zero when status != OK)."""
+
+    packfile_id: bytes
+    status: ProofStatus
+    digest: bytes = b"\x00" * BLOB_HASH_LEN
+
+    def __post_init__(self) -> None:
+        _check("proof packfile id", self.packfile_id, PACKFILE_ID_LEN)
+        _check("proof digest", self.digest, BLOB_HASH_LEN)
+
+    def encode(self, w: Writer) -> None:
+        w.fixed(self.packfile_id)
+        w.u32(int(self.status))
+        w.fixed(self.digest)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "StorageProof":
+        return cls(packfile_id=r.fixed(PACKFILE_ID_LEN),
+                   status=ProofStatus(r.u32()),
+                   digest=r.fixed(BLOB_HASH_LEN))
 
 
 @dataclass(frozen=True)
 class P2PBody:
-    """Union of the three signed p2p body kinds (p2p_message.rs:27-61):
-    connection-init request (seq 0), file payload, ack."""
+    """Union of the signed p2p body kinds (p2p_message.rs:27-61 plus the
+    attestation pair): connection-init request (seq 0), file payload, ack,
+    audit challenge batch, audit proof batch."""
 
     kind: P2PBodyKind
     header: P2PHeader
@@ -442,6 +531,8 @@ class P2PBody:
     file_id: bytes = b""  # FILE: packfile id or index number (LE bytes)
     data: bytes = b""  # FILE payload
     acked_sequence: int = 0  # ACK
+    challenges: tuple = ()  # CHALLENGE: StorageChallenge...
+    proofs: tuple = ()  # PROOF: StorageProof...
 
     def encode_bytes(self) -> bytes:
         w = Writer()
@@ -455,6 +546,14 @@ class P2PBody:
             w.blob(self.data)
         elif self.kind == P2PBodyKind.ACK:
             w.u64(self.acked_sequence)
+        elif self.kind == P2PBodyKind.CHALLENGE:
+            w.u64(len(self.challenges))
+            for c in self.challenges:
+                c.encode(w)
+        elif self.kind == P2PBodyKind.PROOF:
+            w.u64(len(self.proofs))
+            for p in self.proofs:
+                p.encode(w)
         return w.take()
 
     @classmethod
@@ -471,6 +570,12 @@ class P2PBody:
             kw["data"] = r.blob()
         elif kind == P2PBodyKind.ACK:
             kw["acked_sequence"] = r.u64()
+        elif kind == P2PBodyKind.CHALLENGE:
+            kw["challenges"] = tuple(
+                StorageChallenge.decode(r) for _ in range(r.u64()))
+        elif kind == P2PBodyKind.PROOF:
+            kw["proofs"] = tuple(
+                StorageProof.decode(r) for _ in range(r.u64()))
         r.expect_end()
         return cls(kind=kind, header=header, **kw)
 

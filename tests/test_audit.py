@@ -1,0 +1,454 @@
+"""Storage attestation: challenge–response audits of peer-held packfiles.
+
+Unit level: challenge-table construction/persistence (single-use, write-
+once), prover window digests over the obfuscated store (honest MISSING /
+SHORT admissions), verifier judgment, cursor burning, and the ledger's
+pass/fail/miss demotion policy.
+
+System level: the acceptance scenario — two real clients through the
+coordination server, a passing audit round of >= 8 random-window
+challenges over the batched digest path, then deliberate corruption and
+deletion detected within one round, failure recorded, peer demoted out of
+the free-space matchmaking ordering.  Plus stale-proof rejection via the
+sequence/nonce header and offline-peer miss tolerance.
+"""
+
+import asyncio
+import random
+from dataclasses import replace
+
+import pytest
+
+from backuwup_tpu import defaults, wire
+from backuwup_tpu.audit import (
+    build_challenge_table,
+    check_proofs,
+    compute_proofs,
+    detection_probability,
+    record_fail,
+    record_miss,
+    record_pass,
+    select_challenges,
+)
+from backuwup_tpu.audit.challenge import sample_windows, to_wire
+from backuwup_tpu.audit.prover import deobfuscate_window
+from backuwup_tpu.crypto import KeyManager
+from backuwup_tpu.net.p2p import obfuscate
+from backuwup_tpu.ops.backend import CpuBackend
+from backuwup_tpu.ops.gear import CDCParams
+from backuwup_tpu.snapshot.blob_index import ChallengeTable
+from backuwup_tpu.store import Store
+from backuwup_tpu.wire import ProofStatus
+
+BACKEND = CpuBackend(CDCParams.from_desired(4096))
+KEYS = KeyManager.from_secret(b"\x21" * 32)
+VERIFIER = b"\x07" * 32  # verifier client id
+PID = b"\x42" * 12
+
+
+def _rng_bytes(n, seed=5):
+    return random.Random(seed).randbytes(n)
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = Store(tmp_path / "cfg", data_base=tmp_path / "data")
+    s.set_obfuscation_key(b"\xaa\x01\x7f\x33")
+    yield s
+    s.close()
+
+
+def _install_packfile(store, verifier, pid, raw):
+    """Store ``raw`` the way ReceivedFilesWriter would: obfuscated."""
+    d = store.received_dir(verifier) / "pack"
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / bytes(pid).hex()
+    path.write_bytes(obfuscate(raw, store.get_obfuscation_key()))
+    return path
+
+
+# --------------------------------------------------------------------------
+# challenge construction + table persistence
+# --------------------------------------------------------------------------
+
+
+def test_sample_windows_stay_in_bounds():
+    rng = random.Random(3)
+    for size in (1, 100, 65536, 300_000):
+        for off, ln in sample_windows(size, 50, rand=rng.randbytes):
+            assert 0 <= off and off + ln <= size
+            assert ln == min(defaults.AUDIT_WINDOW_BYTES, size)
+    with pytest.raises(ValueError):
+        sample_windows(0, 4)
+
+
+def test_challenge_table_roundtrip_and_write_once(tmp_path):
+    data = _rng_bytes(200_000)
+    entries = build_challenge_table(BACKEND, data, count=6)
+    assert len(entries) == 6
+    nonces = {e.nonce for e in entries}
+    assert len(nonces) == 6  # fresh nonce per entry
+    tables = ChallengeTable(KEYS, tmp_path)
+    tables.save(PID, entries)
+    assert tables.has(PID)
+    assert tables.load(PID) == entries
+    # single-use nonces must never be regenerated over the same id
+    with pytest.raises(FileExistsError):
+        tables.save(PID, entries)
+
+
+def test_detection_probability_math():
+    assert detection_probability(0.0, 16) == 0.0
+    assert detection_probability(1.0, 1) == 1.0
+    # the docs/audit.md headline number: 1% corruption, 16 probes
+    assert detection_probability(0.01, 16) == pytest.approx(0.1485, abs=1e-3)
+    assert detection_probability(0.1, 8) > 0.56
+
+
+# --------------------------------------------------------------------------
+# prover
+# --------------------------------------------------------------------------
+
+
+def test_deobfuscate_window_at_unaligned_offsets():
+    key = b"\x13\x9e\x00\xf7"
+    raw = _rng_bytes(1000)
+    stream = obfuscate(raw, key)
+    for off, ln in ((0, 100), (1, 37), (2, 500), (3, 997), (777, 223)):
+        assert deobfuscate_window(stream[off:off + ln], key, off) == \
+            raw[off:off + ln]
+
+
+def test_prover_honest_proofs_match_table(store):
+    raw = _rng_bytes(150_000)
+    entries = build_challenge_table(BACKEND, raw, count=5)
+    _install_packfile(store, VERIFIER, PID, raw)
+    proofs = compute_proofs(store, BACKEND, VERIFIER, to_wire(PID, entries))
+    assert [p.status for p in proofs] == [ProofStatus.OK] * 5
+    assert [bytes(p.digest) for p in proofs] == [e.digest for e in entries]
+    result = check_proofs(to_wire(PID, entries),
+                          [e.digest for e in entries], proofs)
+    assert result.passed and result.checked == 5
+
+
+def test_prover_admits_missing_and_truncated(store):
+    raw = _rng_bytes(150_000)
+    entries = build_challenge_table(BACKEND, raw, count=4)
+    path = _install_packfile(store, VERIFIER, PID, raw)
+    challenges = to_wire(PID, entries)
+    expected = [e.digest for e in entries]
+
+    # truncated: windows past the cut come back SHORT
+    path.write_bytes(path.read_bytes()[:1000])
+    proofs = compute_proofs(store, BACKEND, VERIFIER, challenges)
+    assert all(p.status in (ProofStatus.SHORT, ProofStatus.OK)
+               for p in proofs)
+    assert any(p.status == ProofStatus.SHORT for p in proofs)
+    verdict = check_proofs(challenges, expected, proofs)
+    assert not verdict.passed and "short" in verdict.detail
+
+    # deleted: every proof is an honest MISSING
+    path.unlink()
+    proofs = compute_proofs(store, BACKEND, VERIFIER, challenges)
+    assert [p.status for p in proofs] == [ProofStatus.MISSING] * 4
+    verdict = check_proofs(challenges, expected, proofs)
+    assert not verdict.passed and "missing" in verdict.detail
+
+
+def test_check_proofs_rejects_count_mismatch_and_bad_digest():
+    data = _rng_bytes(80_000)
+    entries = build_challenge_table(BACKEND, data, count=3)
+    challenges = to_wire(PID, entries)
+    expected = [e.digest for e in entries]
+    ok = [wire.StorageProof(packfile_id=PID, status=ProofStatus.OK,
+                            digest=e.digest) for e in entries]
+    assert check_proofs(challenges, expected, ok).passed
+    assert not check_proofs(challenges, expected, ok[:2]).passed
+    forged = ok[:2] + [replace(ok[2], digest=b"\x00" * 32)]
+    verdict = check_proofs(challenges, expected, forged)
+    assert not verdict.passed and "digest mismatch" in verdict.detail
+
+
+# --------------------------------------------------------------------------
+# verifier selection: single-use cursor
+# --------------------------------------------------------------------------
+
+
+def test_select_challenges_burns_cursor_and_exhausts(store, tmp_path):
+    raw = _rng_bytes(100_000)
+    tables = ChallengeTable(KEYS, tmp_path / "tables")
+    tables.save(PID, build_challenge_table(BACKEND, raw, count=5))
+    peer = b"\x50" * 32
+    store.record_placement(PID, peer, len(raw))
+
+    first, exp1 = select_challenges(store, tables, peer, samples=3)
+    second, exp2 = select_challenges(store, tables, peer, samples=3)
+    assert len(first) == 3 and len(second) == 2  # table holds only 5
+    # burned: no (offset, nonce) is ever issued twice
+    seen = {(c.offset, c.nonce) for c in first}
+    assert not seen & {(c.offset, c.nonce) for c in second}
+    assert select_challenges(store, tables, peer) == ([], [])
+
+
+# --------------------------------------------------------------------------
+# ledger policy
+# --------------------------------------------------------------------------
+
+
+def test_ledger_miss_demotion_threshold_and_backoff(store):
+    peer = b"\x61" * 32
+    t0 = 1_000_000.0
+    st = record_miss(store, peer, now=t0)
+    assert not st.demoted and st.misses == 1
+    assert st.next_due == t0 + defaults.AUDIT_RETRY_BASE_S
+    st = record_miss(store, peer, now=t0)
+    assert not st.demoted
+    assert st.next_due == t0 + 2 * defaults.AUDIT_RETRY_BASE_S  # backoff
+    st = record_miss(store, peer, now=t0)  # 3rd consecutive: demoted
+    assert st.demoted and st.consecutive_misses == \
+        defaults.AUDIT_DEMOTE_MISSES
+    # a later pass re-promotes and resets the streaks
+    st = record_pass(store, peer, now=t0)
+    assert not st.demoted and st.consecutive_misses == 0
+    assert st.next_due == t0 + defaults.AUDIT_INTERVAL_S
+
+
+def test_ledger_single_failure_demotes_and_excludes_peer(store):
+    peer = b"\x62" * 32
+    store.add_peer_negotiated(peer, 1 << 20)
+    assert any(bytes(p.pubkey) == peer
+               for p in store.find_peers_with_storage())
+    st = record_fail(store, peer, "digest mismatch", now=2.0)
+    assert st.demoted and st.failures == 1
+    assert "digest mismatch" in st.last_result
+    assert peer in {bytes(p) for p in store.demoted_peers()}
+    # demoted peers drop out of the send-path ordering
+    assert all(bytes(p.pubkey) != peer
+               for p in store.find_peers_with_storage())
+
+
+def test_audit_due_scheduling(store):
+    peer = b"\x63" * 32
+    store.record_placement(PID, peer, 1000, now=1.0)
+    assert peer in [bytes(p) for p in store.audit_due_peers(now=2.0)]
+    record_pass(store, peer, now=2.0)
+    assert store.audit_due_peers(now=3.0) == []
+    store.mark_audit_due(peer, now=3.0)  # server AuditDue push
+    assert peer in [bytes(p) for p in store.audit_due_peers(now=3.0)]
+
+
+# --------------------------------------------------------------------------
+# coordination server: reports adjust matchmaking
+# --------------------------------------------------------------------------
+
+
+def test_server_blocks_peer_failing_for_multiple_reporters(tmp_path):
+    from backuwup_tpu.net.server import ServerDB
+
+    db = ServerDB(":memory:")
+    peer, r1, r2 = b"\x70" * 32, b"\x71" * 32, b"\x72" * 32
+    window = defaults.AUDIT_REPORT_WINDOW_S
+    db.save_audit_report(r1, peer, False, "digest mismatch")
+    assert db.audit_failing_reporters(peer, window) == 1
+    db.save_audit_report(r2, peer, False, "missing")
+    assert db.audit_failing_reporters(peer, window) == 2
+    # a LATER pass from one reporter clears that reporter's vote
+    db.save_audit_report(r1, peer, True, "")
+    assert db.audit_failing_reporters(peer, window) == 1
+
+
+def test_storage_queue_skips_audit_blocked_candidate(tmp_path):
+    from backuwup_tpu.net.server import (
+        Connections,
+        ServerDB,
+        StorageQueue,
+    )
+
+    class Online(Connections):
+        def __init__(self):
+            super().__init__()
+            self.pushed = []
+
+        def is_online(self, client_id):
+            return True
+
+        async def notify(self, client_id, msg):
+            self.pushed.append((bytes(client_id), msg))
+            return True
+
+    async def run():
+        db = ServerDB(":memory:")
+        conns = Online()
+        queue = StorageQueue(db, conns)
+        bad, requester = b"\x80" * 32, b"\x81" * 32
+        for reporter in (b"\x90" * 32, b"\x91" * 32):
+            db.save_audit_report(reporter, bad, False, "missing")
+        await queue.fulfill(bad, 1000)  # bad peer queues a request
+        await queue.fulfill(requester, 1000)
+        # the blocked candidate was skipped, not matched
+        assert all(dst != bad for dst, _ in
+                   [(d, m) for d, m in conns.pushed
+                    if isinstance(m, wire.BackupMatched)])
+        assert db.get_client_negotiated_peers(requester) == []
+
+    asyncio.new_event_loop().run_until_complete(run())
+
+
+# --------------------------------------------------------------------------
+# end-to-end: the acceptance scenario
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def _corpus(root, rng):
+    root.mkdir(parents=True, exist_ok=True)
+    (root / "data.bin").write_bytes(rng.randbytes(300_000))
+
+
+def test_audit_e2e_detects_corruption_and_demotes(tmp_path, loop,
+                                                  monkeypatch):
+    from backuwup_tpu.app import ClientApp
+    from backuwup_tpu.net.server import CoordinationServer
+
+    # the e2e round audits the same peer repeatedly; disable the prover's
+    # per-peer serve throttle so back-to-back rounds are answered, and
+    # grow the per-packfile table so four rounds never exhaust it
+    monkeypatch.setattr(defaults, "AUDIT_SERVE_MIN_INTERVAL_S", 0.0)
+    monkeypatch.setattr(defaults, "AUDIT_CHALLENGES_PER_PACKFILE", 64)
+    rng = random.Random(11)
+    _corpus(tmp_path / "a_src", rng)
+    _corpus(tmp_path / "b_src", rng)
+
+    async def run():
+        server = CoordinationServer(db_path=str(tmp_path / "server.db"))
+        port = await server.start()
+
+        def make_app(name):
+            app = ClientApp(config_dir=tmp_path / name / "cfg",
+                            data_dir=tmp_path / name / "data",
+                            server_addr=f"127.0.0.1:{port}",
+                            backend=CpuBackend(CDCParams.from_desired(4096)))
+            app.store.set_backup_path(str(tmp_path / f"{name}_src"))
+            return app
+
+        a, b = make_app("a"), make_app("b")
+        audit_events = []
+        a.messenger.subscribe(lambda ev: audit_events.append(ev)
+                              if ev.kind == "audit" else None)
+        await a.start()
+        await b.start()
+        await asyncio.wait_for(asyncio.gather(a.backup(), b.backup()), 120)
+        assert a.store.peers_with_placements(), "no placements recorded"
+
+        # --- round 1: intact data, >= 8 challenges, passes ---------------
+        results = await asyncio.wait_for(a.engine.run_audit_round(), 60)
+        verdict = results[bytes(b.client_id)]
+        assert verdict.passed and verdict.checked >= 8, verdict
+        st = a.store.get_audit_state(b.client_id)
+        assert st.passes == 1 and not st.demoted
+        assert [e.payload["outcome"] for e in audit_events] == ["pass"]
+
+        # --- stale proof replay: wrong sequence number is rejected -------
+        async def stale_prover(source, transport):
+            body = await transport.recv_body(10)
+            proofs = compute_proofs(b.store, b.engine.backend, source,
+                                    body.challenges)
+            await transport.send_body(wire.P2PBody(
+                kind=wire.P2PBodyKind.PROOF,
+                header=wire.P2PHeader(
+                    sequence_number=body.header.sequence_number + 7,
+                    session_nonce=transport.session_nonce),
+                proofs=tuple(proofs)))
+
+        b.node.on_audit_request = stale_prover
+        a.store.mark_audit_due(b.client_id)
+        results = await asyncio.wait_for(a.engine.run_audit_round(), 60)
+        verdict = results[bytes(b.client_id)]
+        assert not verdict.passed and "replayed" in verdict.detail
+        b.node.on_audit_request = b._serve_audit
+        record_pass(a.store, b.client_id)  # reset ledger for the next leg
+
+        # --- round 2: corrupt one stored packfile, detect in one round ---
+        pack_dir = b.store.received_dir(a.client_id) / "pack"
+        victim = sorted(pack_dir.iterdir())[0]
+        blob = bytearray(victim.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        victim.write_bytes(bytes(blob))
+        a.store.mark_audit_due(b.client_id)
+        results = await asyncio.wait_for(a.engine.run_audit_round(), 60)
+        verdict = results[bytes(b.client_id)]
+        assert not verdict.passed, "corruption escaped a full audit round"
+        st = a.store.get_audit_state(b.client_id)
+        assert st.failures >= 1 and st.demoted
+        assert bytes(b.client_id) in {bytes(p)
+                                      for p in a.store.demoted_peers()}
+        assert audit_events[-1].payload["outcome"] == "fail"
+        assert audit_events[-1].payload["demoted"] is True
+        # ... and the server heard about it
+        assert server.db.audit_failing_reporters(
+            bytes(b.client_id), defaults.AUDIT_REPORT_WINDOW_S) == 1
+
+        # --- round 3: deleted packfile is an honest MISSING failure ------
+        record_pass(a.store, b.client_id)
+        victim.unlink()
+        a.store.mark_audit_due(b.client_id)
+        results = await asyncio.wait_for(a.engine.run_audit_round(), 60)
+        verdict = results[bytes(b.client_id)]
+        assert not verdict.passed and "missing" in verdict.detail
+
+        await a.stop()
+        await b.stop()
+        await server.stop()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 240))
+
+
+def test_audit_offline_peer_records_miss(tmp_path, loop):
+    from backuwup_tpu.app import ClientApp
+    from backuwup_tpu.net.server import CoordinationServer
+
+    rng = random.Random(12)
+    _corpus(tmp_path / "a_src", rng)
+    _corpus(tmp_path / "b_src", rng)
+
+    async def run():
+        server = CoordinationServer(db_path=str(tmp_path / "server.db"))
+        port = await server.start()
+
+        def make_app(name):
+            app = ClientApp(config_dir=tmp_path / name / "cfg",
+                            data_dir=tmp_path / name / "data",
+                            server_addr=f"127.0.0.1:{port}",
+                            backend=CpuBackend(CDCParams.from_desired(4096)))
+            app.store.set_backup_path(str(tmp_path / f"{name}_src"))
+            return app
+
+        a, b = make_app("a"), make_app("b")
+        await a.start()
+        await b.start()
+        await asyncio.wait_for(asyncio.gather(a.backup(), b.backup()), 120)
+
+        # peer goes offline: the audit is a MISS, tolerated, backed off
+        await b.stop()
+        a.store.mark_audit_due(b.client_id)
+        results = await asyncio.wait_for(a.engine.run_audit_round(), 60)
+        verdict = results[bytes(b.client_id)]
+        assert not verdict.passed and verdict.checked == 0
+        st = a.store.get_audit_state(b.client_id)
+        assert st.misses == 1 and not st.demoted  # offline is not data loss
+        assert st.next_due > st.last_audit  # exponential backoff scheduled
+        # challenges burned for the miss stay burned (single-use), but the
+        # peer is NOT excluded from matchmaking
+        assert bytes(b.client_id) not in {bytes(p)
+                                          for p in a.store.demoted_peers()}
+
+        await a.stop()
+        await server.stop()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 240))

@@ -122,3 +122,37 @@ def test_parse_recovery_accepts_both_forms():
     assert parse_recovery(secret_to_words(secret)) == secret
     with pytest.raises(ValueError):
         parse_recovery("not a recovery phrase at all")
+
+
+def test_truncated_phrase_flags_exact_prefix_words_ambiguous():
+    """In truncation-style entry, a word that is both a list word AND a
+    proper prefix of longer list words (bell/belly) is ambiguous — the
+    transcriber may have cut either word down to it."""
+    words = ["bell"] + ["zebra"] * 22 + ["abst"]  # 'abst' -> abstract only
+    with pytest.raises(ValueError, match="ambiguous word 'bell'"):
+        words_to_secret(" ".join(words))
+    # the same word in a FULLY-spelled phrase resolves exactly (wrong
+    # checksum here, but resolution must get that far)
+    full = ["bell"] + ["zebra"] * 23
+    with pytest.raises(ValueError, match="checksum"):
+        words_to_secret(" ".join(full))
+
+
+def test_foreign_wordlist_phrase_gets_actionable_error():
+    """A 24-word BIP39 phrase from another wallet/language names the
+    incompatibility instead of a bare 'unknown word'."""
+    with pytest.raises(ValueError, match="cannot be imported"):
+        words_to_secret("abeja " * 24)  # Spanish BIP39 word
+    with pytest.raises(ValueError, match="cannot be imported"):
+        parse_recovery("abeja " * 24)  # surfaced through either-form parse
+
+
+def test_valid_foreign_words_fail_checksum_with_guidance():
+    """All-valid words in a foreign layout die on the checksum with a
+    message explaining the incompatibility."""
+    secret = bytes(range(32))
+    words = secret_to_words(secret).split()
+    swapped = " ".join([words[1], words[0]] + words[2:])
+    assert swapped != " ".join(words)
+    with pytest.raises(ValueError, match="another wallet"):
+        words_to_secret(swapped)

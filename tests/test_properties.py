@@ -9,7 +9,9 @@ properties are pinned here instead:
 * Wire: tree/blob codecs round-trip.
 """
 
+import pytest
 
+pytest.importorskip("hypothesis", reason="hypothesis not installed")
 
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st

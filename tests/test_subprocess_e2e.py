@@ -13,10 +13,12 @@ clients use the host backend for the tiny corpora here.
 
 import json
 import os
+import queue
 import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 import urllib.request
 from pathlib import Path
@@ -40,12 +42,38 @@ def _spawn(args, cwd=REPO):
         bufsize=1)
 
 
+def _reader(proc):
+    """Daemon thread pumping a process's stdout into a queue, so waiting
+    on output can honor a real deadline: a bare ``readline()`` blocks
+    arbitrarily long when the process wedges without exiting, making the
+    ``timeout`` parameter a dead letter.  One reader per process, cached
+    on the Popen object (two readers would steal lines from each other)."""
+    if getattr(proc, "_line_queue", None) is None:
+        q = queue.Queue()
+
+        def pump():
+            for line in proc.stdout:
+                q.put(line)
+            q.put(None)  # EOF sentinel
+
+        threading.Thread(target=pump, daemon=True).start()
+        proc._line_queue = q
+    return proc._line_queue
+
+
 def _wait_line(proc, needle: str, timeout: float = 120) -> str:
     deadline = time.monotonic() + timeout
+    q = _reader(proc)
     lines = []
-    while time.monotonic() < deadline:
-        line = proc.stdout.readline()
-        if not line:
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        try:
+            line = q.get(timeout=remaining)
+        except queue.Empty:
+            break
+        if line is None:
             raise AssertionError(
                 f"process exited before {needle!r}:\n{''.join(lines)}")
         lines.append(line)

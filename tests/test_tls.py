@@ -35,6 +35,8 @@ def loop():
 @pytest.fixture
 def tls_files(tmp_path):
     """Self-signed localhost certificate via the cryptography package."""
+    pytest.importorskip(
+        "cryptography", reason="x509 needs the real cryptography package")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
