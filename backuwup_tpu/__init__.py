@@ -21,11 +21,12 @@ Layer map (mirrors SURVEY.md section 1):
 =====  =============================  ==================================
 layer  reference                       backuwup_tpu
 =====  =============================  ==================================
-L0     ``shared/src``                  :mod:`backuwup_tpu.wire`, :mod:`backuwup_tpu.defaults`
+L0     ``shared/src``                  :mod:`backuwup_tpu.wire`, :mod:`backuwup_tpu.defaults`,
+                                       :mod:`backuwup_tpu.utils` (retry / faults / tracing)
 L1     ``client/src/key_manager.rs``   :mod:`backuwup_tpu.crypto`
-L2     ``client/src/config``           :mod:`backuwup_tpu.store.config_db`
-L3     ``client/src/backup``           :mod:`backuwup_tpu.ops`, :mod:`backuwup_tpu.models`,
-                                       :mod:`backuwup_tpu.store`, :mod:`backuwup_tpu.engine`
+L2     ``client/src/config``           :mod:`backuwup_tpu.store`
+L3     ``client/src/backup``           :mod:`backuwup_tpu.ops`, :mod:`backuwup_tpu.snapshot`,
+                                       :mod:`backuwup_tpu.engine`, :mod:`backuwup_tpu.audit`
 L4     ``client/src/net_*``            :mod:`backuwup_tpu.net`
 L5     ``client/src/ui``               :mod:`backuwup_tpu.ui`
 L6     ``server/src``                  :mod:`backuwup_tpu.net.server`

@@ -106,6 +106,7 @@ class ClientApp:
             except (asyncio.CancelledError, Exception):
                 pass
             self._audit_task = None
+        await self.engine.aclose()
         await self.server.close()
         self.store.close()
 

@@ -26,11 +26,13 @@ from typing import Optional
 
 from .. import defaults
 from ..store import AuditState, Store
+from ..utils import retry
 
 
 def _backoff(consecutive: int) -> float:
-    return min(defaults.AUDIT_RETRY_BASE_S * 2 ** max(0, consecutive - 1),
-               defaults.AUDIT_BACKOFF_CAP_S)
+    # the unified retry policy (utils/retry.py); AUDIT pins jitter=0 so the
+    # persisted next_due schedule stays exactly predictable
+    return retry.AUDIT.delay_s(consecutive)
 
 
 def record_pass(store: Store, peer: bytes,

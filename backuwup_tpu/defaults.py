@@ -44,6 +44,25 @@ STORAGE_REQUEST_STEP = 50 * 1000 * 1000  # 50 MB (decimal, like the reference)
 STORAGE_REQUEST_CAP = 150 * 1000 * 1000  # 150 MB
 PEER_OVERUSE_GRACE = 16 * MiB  # tolerated overshoot per peer (defaults.rs:34)
 
+# --- unified retry policies (utils/retry.py; no reference equivalent — the
+# reference hardcodes each of these inline) ----------------------------------
+RETRY_JITTER = 0.1  # default +/- fraction applied to every delay
+DIAL_RETRY_BASE_S = 0.5  # p2p dial (handle_connections.rs:145-165 used 0.5)
+DIAL_RETRY_CAP_S = 2.0
+DIAL_RETRY_ATTEMPTS = 2  # retries after the first dial (3 dials total)
+WS_RECONNECT_BASE_S = 0.2  # server push channel (net_server/mod.rs:26-55)
+WS_RECONNECT_CAP_S = 30.0
+STORAGE_REQUEST_RETRY_CAP_S = 60.0  # re-request backoff ceiling
+SEND_IDLE_BASE_S = 0.05  # send loop waiting on the packer
+SEND_IDLE_CAP_S = 0.25
+PEER_WAIT_BASE_S = 0.2  # send loop waiting for a usable peer
+PEER_WAIT_CAP_S = 1.0
+
+# --- peer-loss repair (utils/faults.py, engine.repair_round) -----------------
+# A peer unseen for this long is treated as lost even without an audit
+# demotion: its placements are orphaned and repair re-replicates them.
+PEER_DARK_DEADLINE_S = 3 * 24 * 3600.0
+
 # --- protocol limits (reference shared/src/constants.rs:4-7) ----------------
 MAX_BACKUP_STORAGE_REQUEST_SIZE = 16 * GiB
 BACKUP_REQUEST_EXPIRY_S = 300.0

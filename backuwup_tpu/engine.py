@@ -50,8 +50,8 @@ from .ops.backend import ChunkerBackend, select_backend
 from .snapshot.blob_index import BlobIndex, ChallengeTable
 from .snapshot.packer import DirPacker
 from .snapshot.packfile import PackfileReader, PackfileWriter
-from .store import EVENT_BACKUP, EVENT_RESTORE_REQUEST, Store
-from .utils import tracing
+from .store import EVENT_BACKUP, EVENT_REPAIR, EVENT_RESTORE_REQUEST, Store
+from .utils import retry, tracing
 
 
 class EngineError(Exception):
@@ -137,6 +137,12 @@ class Engine:
         # (restore_orchestrator.rs:45-56); a second start must fail loudly,
         # not corrupt the pack dir with a concurrent packer
         self._exclusive = asyncio.Lock()
+        # peer-loss repair: the demotion hook spawns repair rounds unless a
+        # test drives them explicitly; _avoid_peers excludes the peers
+        # under repair from placement while a round runs
+        self.auto_repair = True
+        self._repair_task: Optional[asyncio.Task] = None
+        self._avoid_peers: set = set()
 
     @staticmethod
     def _default_mesh():
@@ -296,7 +302,12 @@ class Engine:
 
     async def _send_loop(self, orch: Orchestrator, estimate: int) -> None:
         fulfilled = 0
-        last_request = 0.0
+        # unified retry shapes (utils/retry.py): the storage re-request
+        # backs off across consecutive dry spells, the two pacing waits
+        # grow toward their caps while idle and reset on progress
+        request_timer = retry.RetryTimer(retry.STORAGE_REQUEST)
+        pack_wait = retry.Backoff(retry.SEND_IDLE)
+        peer_wait = retry.Backoff(retry.PEER_WAIT)
         while True:
             buffer = orch.buffer_bytes
             # backpressure (send.rs:52-54, 95-100)
@@ -309,7 +320,7 @@ class Engine:
                 self._log("packing resumed")
             if buffer <= 0:
                 if not orch.packing_completed:
-                    await asyncio.sleep(0.05)  # no dir scan on idle ticks
+                    await pack_wait.sleep()  # no dir scan on idle ticks
                     continue
                 # counter says drained: confirm with one real scan before
                 # finishing (the counter is advisory, the dir is truth)
@@ -322,16 +333,18 @@ class Engine:
                 if not unsent:
                     orch.set_buffer(0)
                     continue
+            pack_wait.reset()
             # a peer only qualifies if it can take the next packfile —
             # otherwise an almost-full peer would be reacquired forever
             # and the storage-request branch would starve
             transport, peer_id, peer_free = await self._get_peer_connection(
-                orch, estimate, fulfilled, last_request,
+                orch, estimate, fulfilled, request_timer,
                 min_free=min(s for _, _, s in unsent))
             if transport is None:
-                last_request = time.time()
-                await asyncio.sleep(0.2)
+                await peer_wait.sleep()
                 continue
+            peer_wait.reset()
+            request_timer.reset()
             sent_any = False
             for pid, path, size in unsent:
                 if size > peer_free + defaults.PEER_OVERUSE_GRACE // 2:
@@ -357,11 +370,13 @@ class Engine:
                 self._progress(bytes_transmitted=orch.bytes_sent)
             if not sent_any:
                 await self._drop_transport(orch, peer_id)
-                await asyncio.sleep(0.1)
+                await peer_wait.sleep()
         # index files last, watermarked (send.rs:135-176)
         await self._send_index_files(orch, estimate, fulfilled)
 
     async def _send_index_files(self, orch, estimate, fulfilled) -> None:
+        request_timer = retry.RetryTimer(retry.STORAGE_REQUEST)
+        peer_wait = retry.Backoff(retry.PEER_WAIT)
         while True:
             # Re-filter by the persisted watermark every attempt so a retry
             # after a mid-batch failure never re-sends files already acked
@@ -374,10 +389,12 @@ class Engine:
             if not files:
                 return
             transport, peer_id, _free = await self._get_peer_connection(
-                orch, estimate, fulfilled, 0.0)
+                orch, estimate, fulfilled, request_timer)
             if transport is None:
-                await asyncio.sleep(0.2)
+                await peer_wait.sleep()
                 continue
+            peer_wait.reset()
+            request_timer.reset()
             try:
                 for f in files:
                     num = int(f.name)
@@ -392,21 +409,27 @@ class Engine:
                 await self._drop_transport(orch, peer_id)
 
     async def _get_peer_connection(self, orch, estimate, fulfilled,
-                                   last_request, min_free: int = 1):
+                                   request_timer, min_free: int = 1):
         """(transport, peer_id, free) — reuse, dial known, or request
         storage (send.rs:209-262).  ``min_free`` is the size of the next
         file to send: peers whose remaining allowance (plus overuse grace)
         cannot take it are skipped so the storage-request path still runs.
+        ``request_timer`` throttles the storage-request branch with
+        jittered backoff across consecutive dry calls (utils/retry.py).
         """
         usable = min_free - defaults.PEER_OVERUSE_GRACE // 2
 
         for peer_id, t in list(orch.active_transports.items()):
+            if bytes(peer_id) in self._avoid_peers:
+                await self._drop_transport(orch, peer_id)
+                continue
             peer = self.store.get_peer(peer_id)
             free = peer.free_storage if peer else 0
             if free > 0 and free >= usable:
                 return t, peer_id, free
             await self._drop_transport(orch, peer_id)
-        for peer in self.store.find_peers_with_storage():
+        for peer in self.store.find_peers_with_storage(
+                exclude=self._avoid_peers):
             if peer.free_storage < usable:
                 continue  # ordered by free space: the rest are smaller
             try:
@@ -421,8 +444,9 @@ class Engine:
                     f"dial {bytes(peer.pubkey).hex()[:8]} failed: {e}")
                 continue
         # no peer available: storage request, throttled (send.rs:296-309)
-        if time.time() - last_request >= defaults.STORAGE_REQUEST_RETRY_S or \
-                not last_request:
+        now = time.time()
+        if request_timer.due(now):
+            request_timer.fire(now)
             missing = max(estimate - fulfilled, 0)
             amount = min(max(missing, defaults.STORAGE_REQUEST_STEP),
                          defaults.STORAGE_REQUEST_CAP)
@@ -540,6 +564,187 @@ class Engine:
         if self.messenger is not None:
             self.messenger.audit(hexid, outcome, detail=detail,
                                  demoted=state.demoted)
+        if state.demoted:
+            self._on_peer_demoted(peer_id)
+
+    # --- peer-loss repair ----------------------------------------------------
+
+    def _on_peer_demoted(self, peer_id: bytes) -> None:
+        """Audit-ledger demotion hook: schedule a repair round.
+
+        Fires at most one background round at a time; tests set
+        ``auto_repair = False`` and drive :meth:`repair_round` explicitly.
+        """
+        if not self.auto_repair:
+            return
+        if self._repair_task is not None and not self._repair_task.done():
+            return
+        self._repair_task = asyncio.create_task(self._auto_repair())
+
+    async def _auto_repair(self) -> None:
+        try:
+            await self.repair_round()
+        except Exception as e:  # background task: log, never crash the app
+            self._log(f"repair round failed: {e}")
+
+    async def aclose(self) -> None:
+        """Cancel any in-flight background repair (app shutdown)."""
+        if self._repair_task is not None:
+            self._repair_task.cancel()
+            try:
+                await self._repair_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._repair_task = None
+
+    async def repair_round(self, now: Optional[float] = None) -> Dict:
+        """Re-replicate packfiles orphaned by demoted or long-dark peers.
+
+        Walks the placement rows for every peer that is audit-demoted or
+        unseen past ``PEER_DARK_DEADLINE_S``, finds the packfiles whose
+        every replica lived on lost peers, forgets those blobs in the
+        index, and re-packs them from the local source tree — CDC + blake3
+        are deterministic, so the unchanged source reproduces exactly the
+        forgotten blobs while everything else dedups away.  The fresh
+        packfiles go to surviving peers through the normal send loop; only
+        then are the dead placements retired and the reclaimed allocation
+        reported to the coordination server.
+        """
+        if self._exclusive.locked():
+            raise EngineError("a backup or restore is already running")
+        async with self._exclusive:
+            return await self._repair_round_locked(now)
+
+    def _lost_peers(self, now: float) -> set:
+        """Peers holding placements that are demoted or dark past deadline."""
+        lost = set()
+        for peer in self.store.peers_with_placements():
+            peer = bytes(peer)
+            st = self.store.get_audit_state(peer)
+            if st.demoted:
+                lost.add(peer)
+                continue
+            info = self.store.get_peer(peer)
+            if info is not None and info.last_seen is not None and \
+                    now - info.last_seen > defaults.PEER_DARK_DEADLINE_S:
+                lost.add(peer)
+        return lost
+
+    async def _repair_round_locked(self, now: Optional[float]) -> Dict:
+        now = time.time() if now is None else now
+        lost = self._lost_peers(now)
+        report: Dict = {"peers": {}, "packfiles": 0, "bytes_lost": 0,
+                        "bytes_replaced": 0, "blobs": 0}
+        if not lost:
+            return report
+        # a packfile is orphaned only if EVERY replica is on a lost peer
+        per_peer: Dict[bytes, list] = {}
+        orphaned: Dict[bytes, int] = {}
+        for peer in lost:
+            rows = self.store.placements_for_peer(peer)
+            per_peer[peer] = rows
+            for pid, size in rows:
+                holders = {bytes(p)
+                           for p in self.store.peers_for_packfile(pid)}
+                if holders <= lost:
+                    orphaned[bytes(pid)] = size
+        lost_hashes = self.index.forget_packfiles(orphaned)
+        bytes_lost = sum(orphaned.values())
+        self._log(f"repair: {len(lost)} lost peer(s), "
+                  f"{len(orphaned)} orphaned packfile(s), "
+                  f"{len(lost_hashes)} blob(s) to re-replicate")
+        bytes_replaced = 0
+        # also run the pipeline when a previous failed round left forgotten
+        # blobs re-packed but unsent on disk: everything dedups, the
+        # leftovers drain, and only then do the placements retire
+        if lost_hashes or self._unsent_packfiles():
+            # the device dedup mesh mirrors the index: rebuild its table
+            # from the pruned map so re-packed blobs are not misclassified
+            # as duplicates
+            if self.device_dedup is not None:
+                from .snapshot.device_dedup import MeshDedupIndex
+                self.device_dedup = MeshDedupIndex(
+                    self.device_dedup.mesh, self.index)
+            self._avoid_peers = set(lost)
+            try:
+                bytes_replaced = await self._repack_and_send(bytes_lost)
+            finally:
+                self._avoid_peers = set()
+        # placements retire only after the replacement copies are acked;
+        # a failed round leaves the rows so the next round retries (the
+        # forget is idempotent and the re-pack dedups what already went)
+        from dataclasses import replace
+        for peer in lost:
+            retired = self.store.retire_placements(peer)
+            st = self.store.get_audit_state(peer)
+            if not st.demoted:
+                # dark-but-never-audited peers: persist the demotion so
+                # they stay out of placement after this round
+                self.store.put_audit_state(replace(
+                    st, demoted=True,
+                    last_result="dark: placements repaired away"))
+            peer_lost = sum(s for pid, s in per_peer[peer]
+                            if bytes(pid) in orphaned)
+            report["peers"][bytes(peer).hex()] = {
+                "placements_retired": retired, "bytes_lost": peer_lost}
+            try:
+                await self.server.repair_report(
+                    peer, packfiles_lost=len(orphaned),
+                    bytes_lost=peer_lost, bytes_replaced=bytes_replaced)
+            except Exception as e:
+                self._log(f"repair report for {bytes(peer).hex()[:8]} "
+                          f"failed: {e}")
+        report.update(packfiles=len(orphaned), bytes_lost=bytes_lost,
+                      bytes_replaced=bytes_replaced, blobs=len(lost_hashes))
+        self.store.add_event(EVENT_REPAIR, {
+            "peers": [bytes(p).hex() for p in lost],
+            "packfiles": len(orphaned), "bytes_lost": bytes_lost,
+            "bytes_replaced": bytes_replaced})
+        self._log(f"repair complete: {bytes_replaced} bytes re-replicated")
+        return report
+
+    async def _repack_and_send(self, bytes_lost: int) -> int:
+        """Re-pack forgotten blobs from source and send to fresh peers.
+
+        Same pack ∥ send machinery as a backup, minus the snapshot upload:
+        the snapshot hash is unchanged (the data is), only placement moves.
+        """
+        root = Path(self.store.get_backup_path() or "")
+        if not root.is_dir():
+            raise EngineError(
+                f"cannot repair: backup path {root} is not a directory")
+        orch = self.orchestrator = Orchestrator()
+        loop = asyncio.get_running_loop()
+        orch.set_buffer(self._buffer_bytes())
+        estimate = max(bytes_lost, 1)
+
+        def pack_thread() -> None:
+            writer = PackfileWriter(
+                self.keys, self._pack_dir(),
+                on_packfile=self._on_packfile_threadsafe(loop))
+            packer = DirPacker(self.backend, writer, self.index,
+                               progress=self._pack_progress,
+                               should_pause=orch.block_if_paused,
+                               dedup_batch=(self.device_dedup.classify_insert
+                                            if self.device_dedup else None))
+            with tracing.span("engine.repair_pack"):
+                packer.pack(root)
+
+        pack_fut = loop.run_in_executor(None, pack_thread)
+        send_task = asyncio.create_task(self._send_loop(orch, estimate))
+        try:
+            await pack_fut
+        except Exception:
+            orch.failed = True
+            send_task.cancel()
+            raise
+        orch.packing_completed = True
+        self.index.flush()
+        try:
+            await send_task
+        except asyncio.CancelledError:
+            raise EngineError("repair send pipeline cancelled")
+        return orch.bytes_sent
 
     # --- restore (backup/mod.rs:117-192) -----------------------------------
 
@@ -622,12 +827,26 @@ class Engine:
         reader = PackfileReader(self.keys, restore_dir / "pack")
         if len(index) == 0:  # no/partial index: rebuild from headers
             index.rebuild_from_packfiles(reader, restore_dir / "pack")
+        # lazily built from packfile headers when the loaded index points
+        # at a packfile that didn't come back (e.g. it was retired by a
+        # repair round but an old index file still names it)
+        fallback: dict = {}
 
         def resolve(h):
             pid = index.lookup(h)
-            if pid is None:
+            if pid is not None:
+                try:
+                    return reader.get_blob(pid, h)
+                except Exception:
+                    pass
+            if "index" not in fallback:
+                fb = BlobIndex(self.keys, restore_dir / "index")
+                fb.rebuild_from_packfiles(reader, restore_dir / "pack")
+                fallback["index"] = fb
+            pid2 = fallback["index"].lookup(h)
+            if pid2 is None or pid2 == pid:
                 raise EngineError(f"blob {bytes(h).hex()} not restored")
-            return reader.get_blob(pid, h)
+            return reader.get_blob(pid2, h)
 
         return index, reader, resolve
 

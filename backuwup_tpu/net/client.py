@@ -23,6 +23,7 @@ import aiohttp
 from .. import wire
 from ..crypto import KeyManager
 from ..store import Store
+from ..utils import retry
 
 
 class ServerError(Exception):
@@ -253,6 +254,15 @@ class ServerClient:
                 session_token=t, peer_id=bytes(peer_id), passed=passed,
                 detail=detail)))
 
+    async def repair_report(self, peer_id: bytes, packfiles_lost: int,
+                            bytes_lost: int, bytes_replaced: int) -> None:
+        await self._with_login(lambda t: self._post(
+            "/repair/report", wire.RepairReport(
+                session_token=t, peer_id=bytes(peer_id),
+                packfiles_lost=int(packfiles_lost),
+                bytes_lost=int(bytes_lost),
+                bytes_replaced=int(bytes_replaced))))
+
     # --- push channel (net_server/mod.rs) ----------------------------------
 
     def start_ws(self) -> asyncio.Task:
@@ -261,6 +271,7 @@ class ServerClient:
         return self._ws_task
 
     async def _ws_loop(self) -> None:
+        backoff = retry.Backoff(retry.WS_RECONNECT)
         while True:
             try:
                 token = await self._token()
@@ -269,6 +280,9 @@ class ServerClient:
                         self.base + "/ws",
                         headers={"Authorization": bytes(token).hex()}) as ws:
                     self.ws_connected.set()
+                    # an accepted connection ends the outage: the next
+                    # failure backs off from the base delay again
+                    backoff.reset()
                     async for msg in ws:
                         if msg.type != aiohttp.WSMsgType.TEXT:
                             break
@@ -284,7 +298,9 @@ class ServerClient:
                 logging.getLogger(__name__).debug(
                     "server WS dropped: %s; reconnecting", e)
             self.ws_connected.clear()
-            await asyncio.sleep(0.2)
+            # unified jittered backoff (utils/retry.py), unbounded: the
+            # push channel must always come back eventually
+            await backoff.sleep()
 
     async def _dispatch(self, raw: str) -> None:
         try:

@@ -36,6 +36,7 @@ except ModuleNotFoundError:  # containers without the wheel: aiohttp shim
 from .. import defaults, wire
 from ..crypto import KeyManager, verify_signature
 from ..store import Store
+from ..utils import faults, retry
 
 PURPOSE_TRANSPORT = wire.RequestType.TRANSPORT
 PURPOSE_RESTORE = wire.RequestType.RESTORE_ALL
@@ -153,8 +154,18 @@ class Transport:
             file_info=file_info, file_id=bytes(file_id), data=bytes(data))
         ev = asyncio.Event()
         self._acks[seq] = ev
+        raw = _sign_body(self.keys, body)
+        plane = faults.PLANE
+        if plane is not None:  # chaos hook; inert in production (PLANE=None)
+            action = await plane.on_send(self.peer_id)
+            if action == faults.ACT_DROP:
+                await self.close()
+                self._acks.pop(seq, None)
+                raise P2PError(f"injected connection drop at seq {seq}")
+            if action == faults.ACT_CORRUPT:
+                raw = plane.corrupt(raw, self.peer_id)
         try:
-            await asyncio.wait_for(self.ws.send(_sign_body(self.keys, body)),
+            await asyncio.wait_for(self.ws.send(raw),
                                    defaults.PACKFILE_SEND_TIMEOUT_S)
             await asyncio.wait_for(ev.wait(), defaults.ACK_TIMEOUT_S)
         except (asyncio.TimeoutError, websockets.ConnectionClosed) as e:
@@ -218,6 +229,13 @@ class Receiver:
                     f"sequence break: got {body.header.sequence_number}, "
                     f"expected {self.expected_seq} (replay protection)")
             await self.sink(body.file_info, body.file_id, body.data)
+            plane = faults.PLANE
+            if plane is not None \
+                    and plane.withhold_ack_now(self.t.peer_id):
+                # injected crash-between-write-and-ack: the file is
+                # persisted but the sender never learns; do NOT advance
+                # expected_seq — a real crash would lose that state too
+                continue
             ack = wire.P2PBody(
                 kind=wire.P2PBodyKind.ACK,
                 header=wire.P2PHeader(sequence_number=self.expected_seq,
@@ -249,14 +267,24 @@ class ReceivedFilesWriter:
 
     async def sink(self, file_info: wire.FileInfoKind, file_id: bytes,
                    data: bytes) -> None:
-        if len(data) > self._quota_left():
-            raise P2PError("peer exceeded negotiated storage quota")
         sub = "index" if file_info == wire.FileInfoKind.INDEX else "pack"
         d = self.dir / sub
         d.mkdir(parents=True, exist_ok=True)
         path = d / bytes(file_id).hex()
-        if path.exists():  # collision refusal (received_files_writer.rs:54-56)
-            raise P2PError(f"refusing to overwrite {path.name}")
+        if path.exists():
+            # Idempotent re-send: if the sender's ack was lost (crash or
+            # drop between our write and their receive) it will retry the
+            # identical file on a fresh session.  Same id + same bytes =>
+            # ack without re-counting quota; anything else is still the
+            # collision refusal (received_files_writer.rs:54-56).  XOR
+            # obfuscation is deterministic, so comparing stored bytes
+            # against the re-obfuscated payload is exact.
+            if path.read_bytes() == obfuscate(data, self.key):
+                return
+            raise P2PError(f"refusing to overwrite {path.name}"
+                           " with different bytes")
+        if len(data) > self._quota_left():
+            raise P2PError("peer exceeded negotiated storage quota")
         path.write_bytes(obfuscate(data, self.key))
         self.store.add_peer_received(self.peer_id, len(data))
 
@@ -316,6 +344,11 @@ class P2PNode:
     async def connect(self, peer_id: bytes, purpose: wire.RequestType,
                       timeout: float = 15.0) -> Transport:
         peer_id = bytes(peer_id)
+        plane = faults.PLANE
+        if plane is not None and (plane.is_dead(peer_id)
+                                  or plane.is_dead(self.keys.client_id)):
+            # fail fast, exactly like a dial to a vanished host
+            raise P2PError("injected: peer is dead")
         nonce = self.requests.add(peer_id, purpose)
         q = self._finalize_waiters.setdefault(peer_id, asyncio.Queue())
         await self.server.p2p_connection_begin(peer_id, nonce)
@@ -324,16 +357,18 @@ class P2PNode:
         except asyncio.TimeoutError:
             raise P2PError("peer did not confirm p2p connection")
         nonce, purpose = self.requests.finalize(peer_id)
-        ws = None
-        for attempt in range(3):  # dial retries (handle_connections.rs:145-165)
-            try:
-                ws = await websockets.connect(
-                    f"ws://{addr}", max_size=defaults.MAX_P2P_MESSAGE_SIZE)
-                break
-            except OSError:
-                await asyncio.sleep(0.5)
-        if ws is None:
-            raise P2PError(f"could not dial peer at {addr}")
+
+        # dial retries (handle_connections.rs:145-165) through the unified
+        # retry policy: 3 dials with jittered exponential backoff
+        async def _dial():
+            return await websockets.connect(
+                f"ws://{addr}", max_size=defaults.MAX_P2P_MESSAGE_SIZE)
+
+        try:
+            ws = await retry.retry_async(_dial, retry.DIAL,
+                                         retry_on=(OSError,))
+        except OSError as e:
+            raise P2PError(f"could not dial peer at {addr}: {e}") from e
         init = wire.P2PBody(
             kind=wire.P2PBodyKind.REQUEST,
             header=wire.P2PHeader(sequence_number=0, session_nonce=nonce),
@@ -352,6 +387,9 @@ class P2PNode:
 
     async def _handle_incoming(self, msg: wire.IncomingP2PConnection) -> None:
         source = bytes(msg.source_client_id)
+        plane = faults.PLANE
+        if plane is not None and plane.is_dead(self.keys.client_id):
+            return  # injected death: a dead host answers no rendezvous
         if self.store.get_peer(source) is None:
             return  # unknown peer: refuse (handle_connections.rs:31-45)
         expected_nonce = msg.session_nonce

@@ -60,7 +60,35 @@ CREATE TABLE IF NOT EXISTS audit_reports (
 );
 CREATE INDEX IF NOT EXISTS audit_reports_by_peer
     ON audit_reports (peer, timestamp);
+CREATE TABLE IF NOT EXISTS repair_reports (
+    reporter BLOB NOT NULL,
+    peer BLOB NOT NULL,
+    packfiles_lost INTEGER NOT NULL,
+    bytes_lost INTEGER NOT NULL,
+    bytes_replaced INTEGER NOT NULL,
+    timestamp REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS metadata (
+    key TEXT PRIMARY KEY,
+    value TEXT NOT NULL
+);
 """
+
+#: Bump when the schema changes shape; pre-versioning databases (PR 1 and
+#: earlier, which had no ``metadata`` table) count as version 1.
+SCHEMA_VERSION = 2
+
+#: THE migration seam: ``{from_version: [SQL statements]}`` applied in
+#: sequence by :meth:`ServerDB._migrate` to reach ``from_version + 1``.
+#: Statements must be idempotent (IF NOT EXISTS / OR IGNORE) because a
+#: crash between a migration and the version stamp replays it on the next
+#: boot.  A Postgres twin of ServerDB would run the same ladder.
+_MIGRATIONS = {
+    # v1 (PR 1) -> v2: repair_reports + the metadata table itself.  Both
+    # already appear in _SCHEMA's CREATE IF NOT EXISTS, so this rung is
+    # empty — it exists to document the pattern for the next real change.
+    1: [],
+}
 
 
 class ServerDB:
@@ -85,6 +113,47 @@ class ServerDB:
         self._db.execute("PRAGMA synchronous=NORMAL")
         self._db.executescript(_SCHEMA)
         self._db.commit()
+        self._migrate()
+
+    def _migrate(self) -> None:
+        """Boot-time schema version check (VERDICT r5 missing #3).
+
+        * fresh or pre-versioning database -> run the ladder from v1 and
+          stamp :data:`SCHEMA_VERSION` (the _SCHEMA script is idempotent,
+          so replaying it on a v1 database upgrades it in place);
+        * versioned database older than the code -> apply each rung of
+          :data:`_MIGRATIONS` in order, stamping after each one;
+        * database NEWER than the code -> refuse to start: old code
+          writing rows a newer schema reinterprets is silent corruption.
+        """
+        row = self._db.execute(
+            "SELECT value FROM metadata WHERE key = 'schema_version'"
+        ).fetchone()
+        version = int(row[0]) if row is not None else 1
+        if version > SCHEMA_VERSION:
+            raise RuntimeError(
+                f"database schema v{version} is newer than this server"
+                f" (v{SCHEMA_VERSION}); upgrade the server binary")
+        while version < SCHEMA_VERSION:
+            for stmt in _MIGRATIONS.get(version, ()):
+                self._db.execute(stmt)
+            version += 1
+            self._db.execute(
+                "INSERT INTO metadata (key, value) VALUES"
+                " ('schema_version', ?) ON CONFLICT(key)"
+                " DO UPDATE SET value = excluded.value", (str(version),))
+            self._db.commit()
+        if row is None:
+            self._db.execute(
+                "INSERT OR IGNORE INTO metadata (key, value) VALUES"
+                " ('schema_version', ?)", (str(SCHEMA_VERSION),))
+            self._db.commit()
+
+    def schema_version(self) -> int:
+        row = self._db.execute(
+            "SELECT value FROM metadata WHERE key = 'schema_version'"
+        ).fetchone()
+        return int(row[0])
 
     def register_client(self, pubkey: bytes) -> None:
         self._db.execute(
@@ -153,6 +222,27 @@ class ServerDB:
             " timestamp) VALUES (?, ?, ?, ?, ?)",
             (reporter, peer, int(passed), detail, time.time()))
         self._db.commit()
+
+    def save_repair_report(self, reporter: bytes, peer: bytes,
+                           packfiles_lost: int, bytes_lost: int,
+                           bytes_replaced: int) -> None:
+        self._db.execute(
+            "INSERT INTO repair_reports (reporter, peer, packfiles_lost,"
+            " bytes_lost, bytes_replaced, timestamp) VALUES (?, ?, ?, ?, ?, ?)",
+            (reporter, peer, int(packfiles_lost), int(bytes_lost),
+             int(bytes_replaced), time.time()))
+        self._db.commit()
+
+    def reclaim_negotiation(self, client: bytes, peer: bytes) -> int:
+        """Retire every negotiation edge between ``client`` and a lost
+        ``peer`` (both directions): the allowance is unusable, and restore
+        peer lists must stop naming the dead peer.  Returns rows removed."""
+        cur = self._db.execute(
+            "DELETE FROM peer_backups WHERE (source = ? AND destination = ?)"
+            " OR (source = ? AND destination = ?)",
+            (client, peer, peer, client))
+        self._db.commit()
+        return cur.rowcount
 
     def audit_failing_reporters(self, peer: bytes,
                                 window_s: float) -> int:
@@ -487,6 +577,23 @@ class CoordinationServer:
                         source, wire.AuditDue(peer_id=peer))
         return self._ok()
 
+    async def repair_report(self, request):
+        """Record a completed peer-loss repair and reclaim the negotiation
+        edges between the reporter and the lost peer, so the reporter's
+        restore peer list drops the dead peer immediately.  Only the
+        reporter's own edges are touched — other clients keep their own
+        view of the peer until their own audits/repairs decide."""
+        msg = await self._parse(request, wire.RepairReport)
+        client = self._session(msg)
+        peer = bytes(msg.peer_id)
+        if peer == client:
+            raise self._err(wire.ErrorKind.BAD_REQUEST,
+                            "cannot repair away from self")
+        self.db.save_repair_report(client, peer, msg.packfiles_lost,
+                                   msg.bytes_lost, msg.bytes_replaced)
+        self.db.reclaim_negotiation(client, peer)
+        return self._ok()
+
     async def ws(self, request):
         token = request.headers.get("Authorization")
         try:
@@ -522,6 +629,7 @@ class CoordinationServer:
             web.post("/p2p/connection/begin", self.p2p_begin),
             web.post("/p2p/connection/confirm", self.p2p_confirm),
             web.post("/audit/report", self.audit_report),
+            web.post("/repair/report", self.repair_report),
             web.get("/ws", self.ws),
         ])
         return app

@@ -166,6 +166,30 @@ class BlobIndex:
     def lookup(self, blob_hash: bytes) -> Optional[bytes]:
         return self._map.get(bytes(blob_hash))
 
+    def hashes_for_packfiles(self, packfile_ids: Iterable[bytes]) -> Set[bytes]:
+        """Committed blob hashes living in any of ``packfile_ids`` — the
+        finalize_packfile bookkeeping read backwards (lost packfile ->
+        which blobs must be re-packed)."""
+        targets = {bytes(p) for p in packfile_ids}
+        return {h for h, pid in self._map.items() if pid in targets}
+
+    def forget_packfiles(self, packfile_ids: Iterable[bytes]) -> Set[bytes]:
+        """Drop every committed entry that maps into ``packfile_ids``.
+
+        The repair path calls this for packfiles whose only replicas were
+        on a lost peer: once forgotten, ``is_duplicate`` answers False for
+        exactly those blobs, so a re-pack over the unchanged source
+        re-creates them (CDC + blake3 are deterministic) while every other
+        blob still dedups away.  Returns the forgotten hashes.
+        """
+        targets = {bytes(p) for p in packfile_ids}
+        lost = {h for h, pid in self._map.items() if pid in targets}
+        for h in lost:
+            del self._map[h]
+        self._unsaved = [(h, pid) for h, pid in self._unsaved
+                         if pid not in targets]
+        return lost
+
     def packfile_ids(self) -> Set[bytes]:
         return set(self._map.values())
 
@@ -217,7 +241,15 @@ class BlobIndex:
         return written
 
     def load(self) -> int:
-        """Read every index file in numeric order; returns entry count."""
+        """Read every index file in numeric order; returns entry count.
+
+        Later files WIN on duplicate hashes: a repair round re-homes blobs
+        whose packfile died with a peer and flushes the new mapping into a
+        new (higher-numbered) index file, so after a reload — or a restore
+        that pulls every index file back — the hash must resolve to the
+        replacement packfile, not the retired one still named by the
+        original file.
+        """
         if not self.index_dir.is_dir():
             return 0
         files = sorted(p for p in self.index_dir.iterdir()
@@ -230,7 +262,7 @@ class BlobIndex:
             for _ in range(r.u64()):
                 h = r.fixed(BLOB_HASH_LEN)
                 pid = r.fixed(PACKFILE_ID_LEN)
-                self._map.setdefault(h, pid)
+                self._map[h] = pid
             r.expect_end()
             self._next_file = max(self._next_file, counter + 1)
         return len(self._map)

@@ -72,6 +72,7 @@ CREATE TABLE IF NOT EXISTS audit_ledger (
 
 EVENT_BACKUP = "backup"
 EVENT_RESTORE_REQUEST = "restore_request"
+EVENT_REPAIR = "repair"
 
 
 def config_dir() -> Path:
@@ -272,14 +273,16 @@ class Store:
                 " bytes_negotiated, first_seen, last_seen FROM peers").fetchall()
         return [PeerInfo(bytes(r[0]), *r[1:]) for r in rows]
 
-    def find_peers_with_storage(self) -> list:
+    def find_peers_with_storage(self, exclude=()) -> list:
         """Peers ordered by free (negotiated - transmitted) storage, most
         first (peers.rs:176-193).  Peers the audit ledger demoted are
         excluded entirely: a peer proven to drop data must not receive more.
+        ``exclude`` adds caller-side exclusions (the repair round must not
+        re-place data on the very peers it is repairing away from).
         """
-        demoted = self.demoted_peers()
+        avoid = self.demoted_peers() | {bytes(p) for p in exclude}
         peers = [p for p in self.list_peers()
-                 if p.free_storage > 0 and p.pubkey not in demoted]
+                 if p.free_storage > 0 and p.pubkey not in avoid]
         peers.sort(key=lambda p: p.free_storage, reverse=True)
         return peers
 
@@ -309,6 +312,24 @@ class Store:
             rows = self._db.execute(
                 "SELECT DISTINCT peer FROM placements").fetchall()
         return [bytes(r[0]) for r in rows]
+
+    def peers_for_packfile(self, packfile_id: bytes) -> list:
+        """Every peer recorded as holding ``packfile_id`` — a packfile is
+        orphaned only when ALL of its placements are on lost peers."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT peer FROM placements WHERE packfile_id = ?",
+                (bytes(packfile_id),)).fetchall()
+        return [bytes(r[0]) for r in rows]
+
+    def retire_placements(self, peer: bytes) -> int:
+        """Drop every placement row for a lost peer once repair has
+        re-homed (or written off) its packfiles; returns rows removed."""
+        with self._lock:
+            cur = self._db.execute(
+                "DELETE FROM placements WHERE peer = ?", (bytes(peer),))
+            self._db.commit()
+        return cur.rowcount
 
     # --- audit ledger (docs/audit.md; no reference equivalent) --------------
 

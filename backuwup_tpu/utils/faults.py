@@ -1,0 +1,222 @@
+"""Deterministic, seedable fault-injection plane for the p2p data plane.
+
+The chaos harness of the framework: tests (or an operator via the
+``BKW_FAULTS`` env var) install a :class:`FaultPlane` and the hooks at the
+Transport/Node seam in :mod:`backuwup_tpu.net.p2p` start injecting
+
+* **drop_send** — the connection dies mid-``send_data`` (socket closed,
+  sender sees a ``P2PError``),
+* **corrupt_frame** — one byte of the signed frame is flipped in flight
+  (the receiver's signature check drops it; the sender times out on the
+  ack),
+* **withhold_ack** — the receiver persists the file but the ack never
+  leaves (the crash-between-write-and-ack window; exercises the
+  idempotent re-send path),
+* **latency** — an extra await before the frame goes out,
+* **peer death** — a peer id is marked dead: it answers no rendezvous,
+  accepts no dial, and every in-flight transport to it fails on the next
+  send.  :meth:`FaultPlane.kill_after` arms death after N successful
+  sends — "the peer vanished mid-backup".
+
+Two properties the acceptance bar demands, by construction:
+
+* **Inert when disabled.**  The module-global :data:`PLANE` is ``None``
+  unless explicitly installed; every hook site is a single
+  ``faults.PLANE is not None`` check, so the production path pays one
+  attribute load and no frames, allocations, or RNG draws.
+* **Deterministic under a seed.**  Every decision site draws from its own
+  ``random.Random`` seeded by ``(plane seed, site name)``, so the answer
+  stream of one site is a pure function of the seed and that site's query
+  count — independent of how asyncio interleaves *other* sites.  Tests
+  that need exact placement use :meth:`arm` (fire on the Nth query) which
+  bypasses probability entirely.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import random
+from typing import Dict, Optional, Set
+
+#: send_data asks this before shipping a FILE frame
+ACT_DROP = "drop"
+ACT_CORRUPT = "corrupt"
+
+
+def _site_seed(seed: int, site: str) -> int:
+    digest = hashlib.blake2s(f"{seed}:{site}".encode()).digest()[:8]
+    return int.from_bytes(digest, "little")
+
+
+class FaultPlane:
+    """One installed chaos configuration.
+
+    ``rates`` are per-query probabilities in [0, 1]; ``arm`` pins exact
+    query indices per site for deterministic tests.  Site names follow
+    ``<hook>:<peer hex>`` so each peer direction has an independent
+    stream.
+    """
+
+    def __init__(self, seed: int = 0, *, drop_send: float = 0.0,
+                 corrupt_frame: float = 0.0, withhold_ack: float = 0.0,
+                 latency: float = 0.0, latency_s: float = 0.05):
+        self.seed = int(seed)
+        self.drop_send = float(drop_send)
+        self.corrupt_frame = float(corrupt_frame)
+        self.withhold_ack = float(withhold_ack)
+        self.latency = float(latency)
+        self.latency_s = float(latency_s)
+        self.dead: Set[bytes] = set()
+        self._kill_after: Dict[bytes, int] = {}
+        self._rngs: Dict[str, random.Random] = {}
+        self._queries: Dict[str, int] = {}
+        self._armed: Dict[str, Set[int]] = {}
+        #: observability: fires per site, for test assertions and logs
+        self.fired: Dict[str, int] = {}
+
+    # --- deterministic decision core ---------------------------------------
+
+    def _rng(self, site: str) -> random.Random:
+        rng = self._rngs.get(site)
+        if rng is None:
+            rng = self._rngs[site] = random.Random(
+                _site_seed(self.seed, site))
+        return rng
+
+    def arm(self, site: str, *query_indices: int) -> None:
+        """Force ``site`` to fire on exactly these (0-based) query indices,
+        regardless of rates — the deterministic-placement test API."""
+        self._armed.setdefault(site, set()).update(query_indices)
+
+    def decide(self, site: str, rate: float) -> bool:
+        """One decision draw at ``site``; counts queries and fires."""
+        q = self._queries.get(site, 0)
+        self._queries[site] = q + 1
+        hit = q in self._armed.get(site, ())
+        if not hit and rate > 0.0:
+            hit = self._rng(site).random() < rate
+        elif rate > 0.0:
+            # keep the stream position consistent whether or not armed
+            # indices interleave, so arming never shifts later draws
+            self._rng(site).random()
+        if hit:
+            self.fired[site] = self.fired.get(site, 0) + 1
+        return hit
+
+    # --- peer death ---------------------------------------------------------
+
+    def kill(self, peer_id: bytes) -> None:
+        self.dead.add(bytes(peer_id))
+
+    def revive(self, peer_id: bytes) -> None:
+        self.dead.discard(bytes(peer_id))
+        self._kill_after.pop(bytes(peer_id), None)
+
+    def kill_after(self, peer_id: bytes, sends: int) -> None:
+        """Peer drops dead after ``sends`` more successful FILE sends."""
+        self._kill_after[bytes(peer_id)] = int(sends)
+
+    def is_dead(self, peer_id: bytes) -> bool:
+        return bytes(peer_id) in self.dead
+
+    def _count_send(self, peer_id: bytes) -> bool:
+        """Advance the kill_after counter; True when this send is the one
+        that finds the peer dead."""
+        k = bytes(peer_id)
+        if k not in self._kill_after:
+            return False
+        if self._kill_after[k] <= 0:
+            del self._kill_after[k]
+            self.dead.add(k)
+            return True
+        self._kill_after[k] -= 1
+        return False
+
+    # --- hooks consumed by net/p2p.py ---------------------------------------
+
+    async def on_send(self, peer_id: bytes) -> Optional[str]:
+        """Called by Transport.send_data before shipping a FILE frame.
+        Returns ACT_DROP / ACT_CORRUPT / None; sleeps injected latency."""
+        hexid = bytes(peer_id).hex()
+        if self.latency > 0.0 and self.decide(f"send.latency:{hexid}",
+                                              self.latency):
+            await asyncio.sleep(self.latency_s)
+        if self._count_send(peer_id) or self.is_dead(peer_id):
+            self.fired[f"send.dead:{hexid}"] = \
+                self.fired.get(f"send.dead:{hexid}", 0) + 1
+            return ACT_DROP
+        if self.decide(f"send.drop:{hexid}", self.drop_send):
+            return ACT_DROP
+        if self.decide(f"send.corrupt:{hexid}", self.corrupt_frame):
+            return ACT_CORRUPT
+        return None
+
+    def corrupt(self, raw: bytes, peer_id: bytes) -> bytes:
+        """Flip one deterministically chosen byte of the signed frame."""
+        rng = self._rng(f"corrupt.byte:{bytes(peer_id).hex()}")
+        i = rng.randrange(len(raw))
+        return raw[:i] + bytes([raw[i] ^ 0xFF]) + raw[i + 1:]
+
+    def withhold_ack_now(self, peer_id: bytes) -> bool:
+        """Called by Receiver.run after the sink persisted the file."""
+        return self.decide(f"recv.withhold_ack:{bytes(peer_id).hex()}",
+                           self.withhold_ack)
+
+
+#: The installed plane; None (the default) disables every hook.
+PLANE: Optional[FaultPlane] = None
+
+
+def install(plane: FaultPlane) -> FaultPlane:
+    global PLANE
+    PLANE = plane
+    return plane
+
+
+def uninstall() -> None:
+    global PLANE
+    PLANE = None
+
+
+def from_env(spec: Optional[str] = None) -> Optional[FaultPlane]:
+    """Parse a ``BKW_FAULTS`` spec into a plane (None when unset/empty).
+
+    Format: comma-separated ``key=value``; keys ``seed``, ``drop_send``,
+    ``corrupt_frame``, ``withhold_ack``, ``latency`` (probability),
+    ``latency_s`` (seconds), ``kill`` ('+'-separated hex client ids).
+    Example: ``BKW_FAULTS=seed=7,drop_send=0.05,latency=0.2,latency_s=0.1``
+    """
+    spec = os.environ.get("BKW_FAULTS", "") if spec is None else spec
+    spec = spec.strip()
+    if not spec:
+        return None
+    kw: Dict[str, float] = {}
+    kills = []
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        key, _, value = part.partition("=")
+        key = key.strip()
+        value = value.strip()
+        if key == "kill":
+            kills.extend(bytes.fromhex(v) for v in value.split("+") if v)
+        elif key == "seed":
+            kw["seed"] = int(value)
+        elif key in ("drop_send", "corrupt_frame", "withhold_ack",
+                     "latency", "latency_s"):
+            kw[key] = float(value)
+        else:
+            raise ValueError(f"unknown BKW_FAULTS key {key!r}")
+    seed = int(kw.pop("seed", 0))
+    plane = FaultPlane(seed, **kw)
+    for k in kills:
+        plane.kill(k)
+    return plane
+
+
+# env activation at import time: the p2p module imports this module, so a
+# process started with BKW_FAULTS set gets the plane with no test plumbing
+if os.environ.get("BKW_FAULTS"):
+    PLANE = from_env()

@@ -305,6 +305,23 @@ class AuditReport(JsonMessage):
                      "peer_id": CLIENT_ID_LEN}
 
 
+@dataclass
+class RepairReport(JsonMessage):
+    """Client -> server: one repair round re-replicated the placements a
+    lost ``peer_id`` held for us (no reference equivalent; see
+    docs/failure_model.md).  The server retires the negotiation edges so
+    restore peer lists stop naming the dead peer, and records the event
+    for allocation accounting."""
+
+    session_token: bytes
+    peer_id: bytes
+    packfiles_lost: int
+    bytes_lost: int
+    bytes_replaced: int
+    _bytes_fields = {"session_token": SESSION_TOKEN_LEN,
+                     "peer_id": CLIENT_ID_LEN}
+
+
 # server -> client HTTP responses (reference shared/src/server_message.rs:9-54)
 
 @dataclass

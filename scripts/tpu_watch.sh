@@ -1,10 +1,22 @@
 #!/bin/sh
-# Poll the axon TPU until a trivial op completes; log recovery time.
+# Poll the axon TPU until a trivial op completes; log recovery time, then
+# immediately recapture a benchmark run so the recovery window is measured
+# (BENCH_attempt_<stamp>.json next to bench.py unless BENCH_OUT_DIR is set).
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+BENCH_OUT_DIR="${BENCH_OUT_DIR:-$REPO_DIR}"
 while true; do
     if timeout 25 python -c "
 import jax, numpy as np, jax.numpy as jnp
 print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; then
         echo "TPU RECOVERED at $(date)" >> /tmp/tpu_watch.log
+        stamp="$(date -u +%Y%m%dT%H%M%SZ)"
+        out="$BENCH_OUT_DIR/BENCH_attempt_${stamp}.json"
+        if timeout "${BENCH_TIMEOUT_S:-1800}" \
+                python "$REPO_DIR/bench.py" > "$out" 2>>/tmp/tpu_watch.log; then
+            echo "bench recaptured to $out at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "bench recapture FAILED (see $out) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

@@ -1,0 +1,536 @@
+"""Fault-injection plane + peer-loss repair + unified retry policy.
+
+Unit level: the retry policy shapes (delay progression, caps, jitter
+bounds, attempt budgets, Backoff/RetryTimer state machines), fault-plane
+determinism under a fixed seed (and inertness when disabled), the
+receiver's idempotent re-send acceptance, blob-index forget/last-wins
+semantics, placement retirement, and the server's schema-version gate.
+
+System level: the chaos acceptance scenario — three real clients through
+the coordination server; one peer is killed mid-backup and one frame to
+the surviving peer is corrupted plus one ack withheld (the crash-between-
+write-and-ack window), yet the backup completes; audit demotion of the
+dead peer triggers one ``repair_round()`` that re-replicates every
+orphaned packfile onto the survivor, retires the dead placements, and
+reports the reclaimed allocation; a subsequent restore with the lost peer
+permanently dark reproduces the source tree byte-for-byte.
+"""
+
+import asyncio
+import hashlib
+import random
+import shutil
+from dataclasses import replace
+
+import pytest
+
+from backuwup_tpu import defaults, wire
+from backuwup_tpu.crypto import KeyManager
+from backuwup_tpu.engine import Engine
+from backuwup_tpu.net.p2p import P2PError, ReceivedFilesWriter, obfuscate
+from backuwup_tpu.ops.backend import CpuBackend
+from backuwup_tpu.ops.gear import CDCParams
+from backuwup_tpu.snapshot.blob_index import BlobIndex
+from backuwup_tpu.store import Store
+from backuwup_tpu.utils import faults, retry
+from backuwup_tpu.utils.faults import ACT_CORRUPT, ACT_DROP, FaultPlane
+
+BACKEND = CpuBackend(CDCParams.from_desired(4096))
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture
+def plane():
+    """Install a fault plane; ALWAYS uninstall so other tests stay clean."""
+    installed = faults.install(FaultPlane(seed=1234))
+    yield installed
+    faults.uninstall()
+
+
+# --------------------------------------------------------------------------
+# retry policy
+# --------------------------------------------------------------------------
+
+
+def test_retry_delay_progression_and_cap():
+    p = retry.RetryPolicy(base_s=1.0, cap_s=8.0, jitter=0.0)
+    assert [p.delay_s(a) for a in (1, 2, 3, 4, 5)] == \
+        [1.0, 2.0, 4.0, 8.0, 8.0]
+
+
+def test_retry_jitter_stays_in_band():
+    p = retry.RetryPolicy(base_s=10.0, cap_s=100.0, jitter=0.1)
+    rng = random.Random(3)
+    draws = [p.delay_s(1, rand=rng.random) for _ in range(200)]
+    assert all(9.0 <= d <= 11.0 for d in draws)
+    assert max(draws) - min(draws) > 0.5  # actually jittered
+    # injectable rand pins the draw exactly
+    assert p.delay_s(1, rand=lambda: 0.0) == pytest.approx(9.0)
+    assert p.delay_s(1, rand=lambda: 0.5) == pytest.approx(10.0)
+
+
+def test_backoff_budget_and_reset():
+    p = retry.RetryPolicy(base_s=1.0, cap_s=4.0, jitter=0.0, max_attempts=2)
+    b = retry.Backoff(p)
+    assert b.next_delay() == 1.0
+    assert b.next_delay() == 2.0
+    assert b.next_delay() is None  # budget spent
+    b.reset()
+    assert b.next_delay() == 1.0  # success resets to the base delay
+
+
+def test_retry_timer_due_fire_reset():
+    p = retry.RetryPolicy(base_s=10.0, cap_s=40.0, jitter=0.0)
+    t = retry.RetryTimer(p)
+    assert t.due(0.0)  # fresh timer fires immediately
+    t.fire(100.0)
+    assert not t.due(105.0) and t.due(110.0)
+    t.fire(110.0)  # second consecutive dry spell: window doubles
+    assert not t.due(125.0) and t.due(130.0)
+    t.reset()
+    assert t.due(130.0) and t.attempt == 0
+
+
+def test_retry_async_succeeds_then_exhausts(loop):
+    p = retry.RetryPolicy(base_s=0.001, cap_s=0.01, jitter=0.0,
+                          max_attempts=3)
+    calls = {"n": 0}
+
+    async def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert loop.run_until_complete(retry.retry_async(
+        flaky, p, retry_on=(OSError,))) == "ok"
+    assert calls["n"] == 3
+
+    async def always_down():
+        raise OSError("hard down")
+
+    with pytest.raises(OSError, match="hard down"):
+        loop.run_until_complete(retry.retry_async(
+            always_down, p, retry_on=(OSError,)))
+
+
+def test_audit_policy_matches_ledger_backoff():
+    # the ledger persists absolute next_due times tests assert exactly —
+    # the shared AUDIT policy must stay jitter-free and base*2^(n-1)
+    assert retry.AUDIT.jitter == 0.0
+    assert retry.AUDIT.delay_s(1) == defaults.AUDIT_RETRY_BASE_S
+    assert retry.AUDIT.delay_s(2) == 2 * defaults.AUDIT_RETRY_BASE_S
+    assert retry.AUDIT.delay_s(1000) == defaults.AUDIT_BACKOFF_CAP_S
+
+
+# --------------------------------------------------------------------------
+# fault plane: determinism, inertness, env parsing
+# --------------------------------------------------------------------------
+
+
+def test_plane_disabled_by_default():
+    assert faults.PLANE is None  # one is-None check is the whole overhead
+
+
+def test_plane_decide_deterministic_under_seed():
+    a, b = FaultPlane(seed=7, drop_send=0.3), FaultPlane(seed=7,
+                                                         drop_send=0.3)
+    sa = [a.decide("send.drop:ff", 0.3) for _ in range(200)]
+    sb = [b.decide("send.drop:ff", 0.3) for _ in range(200)]
+    assert sa == sb and any(sa) and not all(sa)
+    # a different site is an independent stream, same seed
+    sc = [a.decide("send.drop:ee", 0.3) for _ in range(200)]
+    assert sc != sa
+    # a different seed changes the stream
+    sd = [FaultPlane(seed=8).decide("send.drop:ff", 0.3)
+          for _ in range(200)]
+    assert sd != sa
+
+
+def test_plane_arming_never_shifts_later_draws():
+    plain, armed = FaultPlane(seed=5), FaultPlane(seed=5)
+    armed.arm("site", 5)
+    a = [plain.decide("site", 0.2) for _ in range(100)]
+    b = [armed.decide("site", 0.2) for _ in range(100)]
+    assert b[5] is True
+    assert [x for i, x in enumerate(a) if i != 5] == \
+        [x for i, x in enumerate(b) if i != 5]
+    assert armed.fired["site"] >= 1
+
+
+def test_plane_kill_after_counts_sends(loop):
+    plane = FaultPlane(seed=0)
+    peer = b"\x11" * 32
+
+    async def run():
+        plane.kill_after(peer, 2)
+        assert await plane.on_send(peer) is None
+        assert await plane.on_send(peer) is None
+        assert await plane.on_send(peer) == ACT_DROP  # the fatal one
+        assert plane.is_dead(peer)
+        assert await plane.on_send(peer) == ACT_DROP  # stays dead
+        plane.revive(peer)
+        assert await plane.on_send(peer) is None
+
+    loop.run_until_complete(run())
+
+
+def test_plane_corrupt_flips_exactly_one_byte():
+    plane = FaultPlane(seed=3)
+    raw = bytes(range(256)) * 4
+    out = plane.corrupt(raw, b"\x22" * 32)
+    assert len(out) == len(raw)
+    assert sum(x != y for x, y in zip(raw, out)) == 1
+
+
+def test_from_env_parses_spec_and_rejects_unknown_keys():
+    assert faults.from_env("") is None
+    plane = faults.from_env(
+        "seed=7,drop_send=0.05,latency=0.2,latency_s=0.1,kill="
+        + "ab" * 32 + "+" + "cd" * 32)
+    assert plane.seed == 7 and plane.drop_send == 0.05
+    assert plane.latency == 0.2 and plane.latency_s == 0.1
+    assert plane.is_dead(b"\xab" * 32) and plane.is_dead(b"\xcd" * 32)
+    with pytest.raises(ValueError, match="unknown BKW_FAULTS key"):
+        faults.from_env("explode=1")
+
+
+def test_injected_corrupt_detected_by_signature_check(plane):
+    # a corrupted signed frame must never verify — the receiver drops it
+    # and the sender's ack timeout drives the retry path
+    from backuwup_tpu.net.p2p import _sign_body, _verify_msg
+    keys = KeyManager.from_secret(b"\x31" * 32)
+    body = wire.P2PBody(
+        kind=wire.P2PBodyKind.FILE,
+        header=wire.P2PHeader(sequence_number=1,
+                              session_nonce=b"\x01" * wire.TRANSPORT_NONCE_LEN),
+        file_info=wire.FileInfoKind.PACKFILE, file_id=b"\x05" * 12,
+        data=b"payload" * 100)
+    raw = _sign_body(keys, body)
+    assert _verify_msg(raw, keys.client_id).data == body.data
+    with pytest.raises((P2PError, ValueError)):
+        _verify_msg(plane.corrupt(raw, b"\x00" * 32), keys.client_id)
+
+
+# --------------------------------------------------------------------------
+# idempotent re-send acceptance (receiver side)
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = Store(tmp_path / "cfg", data_base=tmp_path / "data")
+    s.set_obfuscation_key(b"\xaa\x01\x7f\x33")
+    yield s
+    s.close()
+
+
+def test_sink_acks_identical_resend_without_double_quota(store, loop):
+    peer = b"\x41" * 32
+    store.add_peer_negotiated(peer, 1 << 20)
+    writer = ReceivedFilesWriter(store, peer)
+    data = random.Random(9).randbytes(5000)
+    fid = b"\x0a" * 12
+
+    async def run():
+        await writer.sink(wire.FileInfoKind.PACKFILE, fid, data)
+        received = store.get_peer(peer).bytes_received
+        # lost-ack retry: same id + same bytes is acked, quota NOT re-counted
+        await writer.sink(wire.FileInfoKind.PACKFILE, fid, data)
+        assert store.get_peer(peer).bytes_received == received
+        # same id + different bytes is still the collision refusal
+        with pytest.raises(P2PError, match="refusing to overwrite"):
+            await writer.sink(wire.FileInfoKind.PACKFILE, fid, data[::-1])
+
+    loop.run_until_complete(run())
+
+
+def test_sink_resend_accepted_even_when_quota_exhausted(store, loop):
+    # the duplicate check must run BEFORE the quota check: the first write
+    # already consumed the allowance, and a retry of the very file that
+    # filled it must still be acked
+    peer = b"\x42" * 32
+    store.add_peer_negotiated(peer, 100)
+    writer = ReceivedFilesWriter(store, peer)
+    data = b"z" * (100 + defaults.PEER_OVERUSE_GRACE)  # fills quota+grace
+
+    async def run():
+        await writer.sink(wire.FileInfoKind.PACKFILE, b"\x0b" * 12, data)
+        await writer.sink(wire.FileInfoKind.PACKFILE, b"\x0b" * 12, data)
+
+    loop.run_until_complete(run())
+
+
+# --------------------------------------------------------------------------
+# blob index: forget + last-wins reload (re-homing after repair)
+# --------------------------------------------------------------------------
+
+
+def test_forget_packfiles_reopens_dedup_for_lost_blobs(tmp_path):
+    keys = KeyManager.from_secret(b"\x51" * 32)
+    index = BlobIndex(keys, tmp_path / "idx")
+    pid_a, pid_b = b"\x01" * 12, b"\x02" * 12
+    h1, h2, h3 = (bytes([i]) * 32 for i in (1, 2, 3))
+    index.finalize_packfile(pid_a, [h1, h2])
+    index.finalize_packfile(pid_b, [h3])
+    assert index.hashes_for_packfiles([pid_a]) == {h1, h2}
+    lost = index.forget_packfiles([pid_a])
+    assert lost == {h1, h2}
+    assert not index.is_duplicate(h1) and not index.is_duplicate(h2)
+    assert index.is_duplicate(h3)  # untouched packfile keeps its entries
+    assert index.forget_packfiles([pid_a]) == set()  # idempotent
+
+
+def test_index_reload_last_wins_after_rehoming(tmp_path):
+    keys = KeyManager.from_secret(b"\x52" * 32)
+    h = b"\x07" * 32
+    old_pid, new_pid = b"\x0c" * 12, b"\x0d" * 12
+    index = BlobIndex(keys, tmp_path / "idx")
+    index.finalize_packfile(old_pid, [h])
+    index.flush()  # file 000000 names the soon-to-die packfile
+    index.forget_packfiles([old_pid])
+    index.finalize_packfile(new_pid, [h])  # repair re-homes the blob
+    index.flush()  # file 000001 names the replacement
+    reloaded = BlobIndex(keys, tmp_path / "idx")
+    reloaded.load()
+    assert reloaded.lookup(h) == new_pid  # later file must win
+
+
+# --------------------------------------------------------------------------
+# store: placement retirement + avoid-set exclusion
+# --------------------------------------------------------------------------
+
+
+def test_store_peers_for_packfile_and_retirement(store):
+    pid, p1, p2 = b"\x0e" * 12, b"\x61" * 32, b"\x62" * 32
+    store.record_placement(pid, p1, 1000)
+    store.record_placement(pid, p2, 1000)
+    assert {bytes(p) for p in store.peers_for_packfile(pid)} == {p1, p2}
+    assert store.retire_placements(p1) == 1
+    assert store.placements_for_peer(p1) == []
+    assert {bytes(p) for p in store.peers_for_packfile(pid)} == {p2}
+    assert store.retire_placements(p1) == 0  # idempotent
+
+
+def test_find_peers_with_storage_honors_exclude(store):
+    p1, p2 = b"\x63" * 32, b"\x64" * 32
+    store.add_peer_negotiated(p1, 1 << 20)
+    store.add_peer_negotiated(p2, 1 << 10)
+    assert [bytes(p.pubkey) for p in
+            store.find_peers_with_storage()] == [p1, p2]
+    assert [bytes(p.pubkey) for p in
+            store.find_peers_with_storage(exclude={p1})] == [p2]
+
+
+# --------------------------------------------------------------------------
+# server: schema version gate + repair bookkeeping
+# --------------------------------------------------------------------------
+
+
+def test_server_schema_version_stamped_and_newer_refused(tmp_path):
+    from backuwup_tpu.net.server import SCHEMA_VERSION, ServerDB
+
+    path = str(tmp_path / "server.db")
+    db = ServerDB(path)
+    assert db.schema_version() == SCHEMA_VERSION
+    db._db.execute("UPDATE metadata SET value = ? WHERE key = ?",
+                   (str(SCHEMA_VERSION + 1), "schema_version"))
+    db._db.commit()
+    with pytest.raises(RuntimeError, match="newer than this server"):
+        ServerDB(path)
+
+
+def test_server_reclaim_negotiation_drops_both_directions(tmp_path):
+    from backuwup_tpu.net.server import ServerDB
+
+    db = ServerDB(":memory:")
+    a, b, c = b"\x71" * 32, b"\x72" * 32, b"\x73" * 32
+    db.save_storage_negotiated(a, b, 1000)
+    db.save_storage_negotiated(b, a, 1000)
+    db.save_storage_negotiated(a, c, 1000)
+    assert db.reclaim_negotiation(a, b) == 2
+    assert db.get_client_negotiated_peers(a) == [c]
+    assert db.get_clients_storing_on(a) == []
+
+
+# --------------------------------------------------------------------------
+# engine: demotion hook spawns a repair round
+# --------------------------------------------------------------------------
+
+
+def test_demotion_hook_spawns_one_repair_round(tmp_path, loop):
+    keys = KeyManager.generate()
+    st = Store(tmp_path / "cfg", data_base=tmp_path / "data")
+    eng = Engine(keys, st, server=None, node=None, backend=BACKEND)
+    rounds = []
+
+    async def fake_repair(now=None):
+        rounds.append(now)
+
+    eng.repair_round = fake_repair
+    peer = b"\x65" * 32
+    demoted = replace(st.get_audit_state(peer), demoted=True)
+    healthy = st.get_audit_state(peer)
+
+    async def run():
+        eng._audit_event(peer, "fail", "digest mismatch", demoted)
+        await asyncio.sleep(0)
+        assert len(rounds) == 1
+        eng._audit_event(peer, "pass", "", healthy)  # no spawn on healthy
+        eng.auto_repair = False
+        eng._audit_event(peer, "fail", "x", demoted)  # tests drive manually
+        await asyncio.sleep(0)
+        assert len(rounds) == 1
+        await eng.aclose()
+
+    loop.run_until_complete(run())
+    st.close()
+
+
+def test_repair_round_noop_without_lost_peers(tmp_path, loop):
+    keys = KeyManager.generate()
+    st = Store(tmp_path / "cfg", data_base=tmp_path / "data")
+    eng = Engine(keys, st, server=None, node=None, backend=BACKEND)
+    st.record_placement(b"\x0f" * 12, b"\x66" * 32, 1000)  # healthy holder
+    report = loop.run_until_complete(eng.repair_round(now=1.0))
+    assert report["packfiles"] == 0 and report["bytes_replaced"] == 0
+    st.close()
+
+
+# --------------------------------------------------------------------------
+# chaos end-to-end: the acceptance scenario
+# --------------------------------------------------------------------------
+
+
+def _corpus(root, rng):
+    root.mkdir(parents=True, exist_ok=True)
+    (root / "docs").mkdir()
+    (root / "big.bin").write_bytes(rng.randbytes(280_000))
+    (root / "docs" / "notes.txt").write_bytes(rng.randbytes(90_000))
+    (root / "small.cfg").write_bytes(b"alpha=1\nbeta=2\n")
+
+
+def _tree_digest(root):
+    out = {}
+    for p in sorted(root.rglob("*")):
+        if p.is_file():
+            out[str(p.relative_to(root))] = hashlib.sha256(
+                p.read_bytes()).hexdigest()
+    return out
+
+
+def test_chaos_peer_death_repair_and_dark_restore(tmp_path, loop,
+                                                  monkeypatch, plane):
+    from backuwup_tpu.app import ClientApp
+    from backuwup_tpu.net.server import CoordinationServer
+
+    # small packfiles so the corpus spans several of them; fast ack
+    # timeouts so injected corruption/withholding resolves quickly
+    monkeypatch.setattr(defaults, "PACKFILE_TARGET_SIZE", 64 * 1024)
+    monkeypatch.setattr(defaults, "ACK_TIMEOUT_S", 1.5)
+    monkeypatch.setattr(defaults, "RESTORE_REQUEST_THROTTLE_S", 0.0)
+    monkeypatch.setattr(defaults, "AUDIT_SERVE_MIN_INTERVAL_S", 0.0)
+    rng = random.Random(20)
+    _corpus(tmp_path / "a_src", rng)
+    source_digest = _tree_digest(tmp_path / "a_src")
+
+    async def run():
+        server = CoordinationServer(db_path=str(tmp_path / "server.db"))
+        port = await server.start()
+
+        def make_app(name):
+            app = ClientApp(config_dir=tmp_path / name / "cfg",
+                            data_dir=tmp_path / name / "data",
+                            server_addr=f"127.0.0.1:{port}",
+                            backend=CpuBackend(CDCParams.from_desired(4096)))
+            app.store.set_backup_path(str(tmp_path / "a_src"))
+            return app
+
+        a, b, c = make_app("a"), make_app("b"), make_app("c")
+        for app in (a, b, c):
+            await app.start()
+            # deterministic chaos: no background audit scheduling
+            app._audit_task.cancel()
+        a.engine.auto_repair = False  # this test drives repair explicitly
+        a_hex = bytes(a.client_id).hex()
+        c_hex = bytes(c.client_id).hex()
+
+        # manual negotiation (matchmaking has its own tests): B gets the
+        # larger allowance so the send loop prefers it, then loses it
+        for peer, amt in ((b, 8 << 20), (c, 4 << 20)):
+            a.store.add_peer_negotiated(peer.client_id, amt)
+            peer.store.add_peer_negotiated(a.client_id, amt)
+            server.db.save_storage_negotiated(
+                bytes(a.client_id), bytes(peer.client_id), amt)
+
+        # chaos plan: B vanishes after 2 stored packfiles; C's first frame
+        # is corrupted in flight (signature check + ack-timeout retry);
+        # the first file C persists gets its ack withheld (crash window —
+        # exercises the idempotent re-send acceptance).  The withhold
+        # stream is keyed by the SENDER id, so B's two acked files consume
+        # query indices 0-1 and C's first persisted file is index 2.
+        plane.kill_after(b.client_id, 2)
+        plane.arm(f"send.corrupt:{c_hex}", 0)
+        plane.arm(f"recv.withhold_ack:{a_hex}", 2)
+
+        # --- backup completes despite peer death mid-stream --------------
+        snapshot = await asyncio.wait_for(a.backup(), 180)
+        assert snapshot
+        b_rows = a.store.placements_for_peer(b.client_id)
+        c_rows = a.store.placements_for_peer(c.client_id)
+        assert len(b_rows) == 2, "B should hold exactly its pre-death sends"
+        assert c_rows, "backup did not fail over to the surviving peer"
+        assert plane.fired.get(f"send.dead:{bytes(b.client_id).hex()}")
+        assert plane.fired.get(f"send.corrupt:{c_hex}") == 1
+        assert plane.fired.get(f"recv.withhold_ack:{a_hex}") == 1
+
+        # --- audit-demote the dead peer (3 consecutive misses) -----------
+        import time as _time
+        t0 = _time.time()
+        for i in range(defaults.AUDIT_DEMOTE_MISSES):
+            res = await a.engine.audit_peer(b.client_id, now=t0 + i)
+            assert res is not None and not res.passed
+        st = a.store.get_audit_state(b.client_id)
+        assert st.demoted
+        orphaned_pids = [bytes(pid) for pid, _ in b_rows]
+        lost_hashes = a.engine.index.hashes_for_packfiles(orphaned_pids)
+        assert lost_hashes, "B's packfiles must map to committed blobs"
+
+        # --- one repair round restores full placement coverage ------------
+        report = await asyncio.wait_for(
+            a.engine.repair_round(now=t0 + 10), 180)
+        assert report["packfiles"] == len(orphaned_pids)
+        assert report["blobs"] == len(lost_hashes)
+        assert report["bytes_replaced"] > 0
+        assert a.store.placements_for_peer(b.client_id) == []
+        for h in lost_hashes:  # every lost blob re-homed off the dead peer
+            pid = a.engine.index.lookup(h)
+            assert pid is not None and pid not in orphaned_pids
+            holders = {bytes(p) for p in a.store.peers_for_packfile(pid)}
+            assert holders and bytes(b.client_id) not in holders
+        # reclaimed allocation reported: the dead edge is gone server-side
+        assert server.db.get_client_negotiated_peers(
+            bytes(a.client_id)) == [bytes(c.client_id)]
+        n_reports = server.db._db.execute(
+            "SELECT COUNT(*) FROM repair_reports WHERE peer = ?",
+            (bytes(b.client_id),)).fetchone()[0]
+        assert n_reports == 1
+
+        # --- restore succeeds with B permanently dark ---------------------
+        await b.stop()  # dark for good (the plane also still marks it dead)
+        shutil.rmtree(tmp_path / "a_src")
+        dest = tmp_path / "restored"
+        await asyncio.wait_for(a.restore(dest), 180)
+        assert _tree_digest(dest) == source_digest  # byte-for-byte
+
+        await a.stop()
+        await c.stop()
+        await server.stop()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 500))
