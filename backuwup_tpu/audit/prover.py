@@ -16,7 +16,8 @@ from typing import List, Sequence
 
 from ..net.p2p import obfuscate
 from ..store import Store
-from ..wire import ProofStatus, StorageChallenge, StorageProof
+from ..wire import (PACKFILE_ID_LEN, ProofStatus, StorageChallenge,
+                    StorageProof)
 
 
 def deobfuscate_window(data: bytes, key: bytes, offset: int) -> bytes:
@@ -43,12 +44,16 @@ def compute_proofs(store: Store, backend, verifier_id: bytes,
     key = store.get_obfuscation_key()
     if key is None:
         raise ValueError("obfuscation key not initialized")
-    pack_dir = store.received_dir(verifier_id) / "pack"
+    base = store.received_dir(verifier_id)
     proofs: List[StorageProof] = [None] * len(challenges)  # type: ignore
     pieces = []
     piece_slots = []
     for i, c in enumerate(challenges):
-        path = pack_dir / bytes(c.packfile_id).hex()
+        # 12-byte ids name whole packfiles, 13-byte ids name erasure
+        # shards; ReceivedFilesWriter stores them in sibling subtrees
+        cid = bytes(c.packfile_id)
+        sub = "shard" if len(cid) == PACKFILE_ID_LEN + 1 else "pack"
+        path = base / sub / cid.hex()
         if not path.is_file():
             proofs[i] = StorageProof(packfile_id=c.packfile_id,
                                      status=ProofStatus.MISSING)

@@ -35,8 +35,13 @@ def select_challenges(
 ) -> Tuple[List[StorageChallenge], List[bytes]]:
     """Draw up to ``samples`` unused table entries across everything the
     peer holds, round-robin over packfiles so one big packfile cannot
-    starve the rest.  Returns (wire challenges, expected digests)."""
-    held = [pid for pid, _ in store.placements_for_peer(peer_id)]
+    starve the rest.  Returns (wire challenges, expected digests).
+
+    A placement with shard_index >= 0 is audited under its 13-byte shard
+    id (erasure/stripe.py) — the challenge table, cursor, and the
+    prover's on-disk file are all keyed by that id."""
+    held = [pid if idx < 0 else pid + bytes([idx])
+            for pid, _, idx in store.shard_placements_for_peer(peer_id)]
     pools = []
     for pid in held:
         if not tables.has(pid):

@@ -63,6 +63,16 @@ PEER_WAIT_CAP_S = 1.0
 # demotion: its placements are orphaned and repair re-replicates them.
 PEER_DARK_DEADLINE_S = 3 * 24 * 3600.0
 
+# --- erasure-coded shard placement (erasure/, docs/erasure.md; no reference
+# equivalent — the reference is replication-only) -----------------------------
+# Each sealed packfile is split into RS_K data shards plus RS_M parity
+# shards (systematic GF(2^8) Reed-Solomon); any RS_K of the RS_K+RS_M
+# shards reconstruct the packfile.  Sharding activates per packfile only
+# when a full stripe of distinct peers is available at send time;
+# otherwise the legacy whole-packfile single-peer path runs.
+RS_K = 4
+RS_M = 2
+
 # --- protocol limits (reference shared/src/constants.rs:4-7) ----------------
 MAX_BACKUP_STORAGE_REQUEST_SIZE = 16 * GiB
 BACKUP_REQUEST_EXPIRY_S = 300.0

@@ -44,6 +44,8 @@ from .audit import (
     select_challenges,
 )
 from .crypto import KeyManager
+from .erasure import gf_cpu
+from .erasure import stripe as rs_stripe
 from .net.client import NoBackups, ServerClient, ServerError
 from .net.p2p import P2PError, P2PNode, Receiver, RestoreFilesWriter, Transport
 from .ops.backend import ChunkerBackend, select_backend
@@ -334,6 +336,17 @@ class Engine:
                     orch.set_buffer(0)
                     continue
             pack_wait.reset()
+            # erasure-first: any packfile that can reach RS_K+RS_M distinct
+            # peers right now goes out as a shard stripe; the rest fall
+            # through to the single-peer whole-file path below, so small
+            # swarms behave exactly as before sharding existed
+            unsent, striped = await self._send_stripes(orch, unsent)
+            if striped:
+                fulfilled += striped
+                request_timer.reset()
+                self._progress(bytes_transmitted=orch.bytes_sent)
+            if not unsent:
+                continue
             # a peer only qualifies if it can take the next packfile —
             # otherwise an almost-full peer would be reacquired forever
             # and the storage-request branch would starve
@@ -373,6 +386,165 @@ class Engine:
                 await peer_wait.sleep()
         # index files last, watermarked (send.rs:135-176)
         await self._send_index_files(orch, estimate, fulfilled)
+
+    # --- erasure-coded stripe placement (erasure/) --------------------------
+
+    @staticmethod
+    def _stripe_geometry():
+        """(k, m) when erasure placement is enabled, else None.
+
+        Read per call so tests (and operators) can flip RS_K/RS_M without
+        rebuilding the engine; RS_M = 0 disables striping entirely.
+        """
+        k, m = int(defaults.RS_K), int(defaults.RS_M)
+        if k < 1 or m < 1 or k + m > 256:
+            return None
+        return k, m
+
+    async def _send_stripes(self, orch: Orchestrator, unsent: list):
+        """Place unsent packfiles as k+m shard stripes on distinct peers.
+
+        Per packfile: skip shard indices already placed (deterministic
+        encode makes re-sends byte-identical, so a retry after a crash or
+        a dead peer resumes the same stripe), acquire one fresh transport
+        per missing shard, and delete the local file only once all k+m
+        shards are acked.  Returns (files for the legacy whole-file path,
+        bytes fully placed).  A packfile that already has a whole-file
+        placement, or that cannot reach enough distinct peers this tick,
+        is handed back for the legacy path — never stranded.
+        """
+        geom = self._stripe_geometry()
+        if geom is None:
+            return unsent, 0
+        k, m = geom
+        n = k + m
+        loop = asyncio.get_running_loop()
+        leftover = []
+        placed_bytes = 0
+        for pid, path, size in unsent:
+            holders: Dict[int, bytes] = {}
+            whole_placed = False
+            for peer, idx in self.store.shards_for_packfile(pid):
+                if idx < 0:
+                    whole_placed = True
+                else:
+                    holders[idx] = bytes(peer)
+            if whole_placed:
+                leftover.append((pid, path, size))
+                continue
+            missing = [i for i in range(n) if i not in holders]
+            if not missing:
+                # fully placed by an earlier interrupted run
+                self._finish_stripe(orch, pid, path, size)
+                placed_bytes += size
+                continue
+            shard_size = rs_stripe.HEADER_LEN + gf_cpu.shard_len(size, k)
+            exclude = set(holders.values()) | self._avoid_peers
+            conns = await self._get_stripe_connections(
+                orch, len(missing), exclude, shard_size)
+            if len(conns) < len(missing):
+                leftover.append((pid, path, size))
+                continue
+            try:
+                data = path.read_bytes()
+            except OSError:
+                continue
+            # GF(2^8) matmul (device or numpy oracle): off the event loop
+            containers = await loop.run_in_executor(
+                None, rs_stripe.split_packfile, data, k, m, self.backend)
+            for i in missing:
+                self._save_shard_challenge_table(pid, i, containers[i])
+            all_acked = True
+            for i, (transport, peer_id, _free) in zip(missing, conns):
+                sid = rs_stripe.shard_id(pid, i)
+                try:
+                    await transport.send_data(
+                        containers[i], wire.FileInfoKind.SHARD, sid)
+                except P2PError:
+                    await self._drop_transport(orch, peer_id)
+                    all_acked = False
+                    continue  # remaining shards still go to THEIR peers
+                self.store.add_peer_transmitted(peer_id, len(containers[i]))
+                self.store.record_placement(pid, peer_id, len(containers[i]),
+                                            shard_index=i)
+                holders[i] = bytes(peer_id)
+            if all_acked and len(holders) == n:
+                self._finish_stripe(orch, pid, path, size)
+                placed_bytes += size
+                if self.messenger is not None:
+                    self.messenger.erasure(bytes(pid).hex(), "placed",
+                                           shards=n, rebuilt=0)
+            else:
+                # partial stripe: retried next tick (placed shards skip)
+                leftover.append((pid, path, size))
+        return leftover, placed_bytes
+
+    def _finish_stripe(self, orch: Orchestrator, pid: bytes, path: Path,
+                       size: int) -> None:
+        """Local-delete + accounting once every shard of ``pid`` is acked
+        (the striped analogue of the post-ack unlink in the legacy path)."""
+        try:
+            path.unlink()
+        except OSError:
+            pass
+        orch.bytes_sent += size
+        orch.adjust_buffer(-size)
+        self._log(f"packfile {bytes(pid).hex()[:8]} placed as "
+                  f"{defaults.RS_K}+{defaults.RS_M} stripe")
+
+    def _save_shard_challenge_table(self, pid: bytes, index: int,
+                                    container: bytes) -> None:
+        """Audit table keyed by the 13-byte shard id, built while the
+        shard bytes are local.  Failure degrades auditing, not backup."""
+        sid = rs_stripe.shard_id(pid, index)
+        try:
+            if not self.challenge_tables.has(sid):
+                self.challenge_tables.save(sid, build_challenge_table(
+                    self.backend, container,
+                    count=defaults.AUDIT_CHALLENGES_PER_PACKFILE))
+        except Exception as e:
+            self._log(f"challenge table for shard {sid.hex()[:8]}"
+                      f" failed: {e}")
+
+    async def _get_stripe_connections(self, orch: Orchestrator, need: int,
+                                      exclude: set, min_free: int) -> list:
+        """Up to ``need`` transports to DISTINCT peers outside ``exclude``,
+        each with ``min_free`` bytes of allowance: reuse actives first,
+        then dial known peers most-free-first (same order the legacy
+        single-peer path uses)."""
+        conns = []
+        chosen = set()
+        for peer_id, t in list(orch.active_transports.items()):
+            if len(conns) >= need:
+                break
+            key = bytes(peer_id)
+            if key in exclude or key in chosen:
+                continue
+            peer = self.store.get_peer(key)
+            if peer is not None and peer.free_storage >= min_free:
+                conns.append((t, key, peer.free_storage))
+                chosen.add(key)
+        if len(conns) < need:
+            for peer in self.store.find_peers_with_storage(
+                    exclude=exclude | chosen):
+                if len(conns) >= need:
+                    break
+                key = bytes(peer.pubkey)
+                if peer.free_storage < min_free:
+                    continue  # ordered by free space: the rest are smaller
+                if key in orch.active_transports:
+                    continue  # already weighed in the reuse pass
+                try:
+                    t = await self.node.connect(
+                        key, wire.RequestType.TRANSPORT, timeout=3.0)
+                except (P2PError, ServerError, OSError,
+                        asyncio.TimeoutError) as e:
+                    self._log(f"dial {key.hex()[:8]} failed: {e}")
+                    continue
+                orch.active_transports[key] = t
+                conns.append((t, key, peer.free_storage))
+                chosen.add(key)
+        return conns
 
     async def _send_index_files(self, orch, estimate, fulfilled) -> None:
         request_timer = retry.RetryTimer(retry.STORAGE_REQUEST)
@@ -450,8 +622,14 @@ class Engine:
             missing = max(estimate - fulfilled, 0)
             amount = min(max(missing, defaults.STORAGE_REQUEST_STEP),
                          defaults.STORAGE_REQUEST_CAP)
+            # with erasure enabled, ask the matchmaker for a full stripe's
+            # worth of DISTINCT peers so grants spread instead of landing
+            # on one giant candidate (server caps per-candidate share)
+            geom = self._stripe_geometry()
+            min_peers = (geom[0] + geom[1]) if geom else 1
             try:
-                await self.server.backup_storage_request(amount)
+                await self.server.backup_storage_request(
+                    amount, min_peers=min_peers)
             except Exception:
                 pass
         return None, None, 0
@@ -634,24 +812,49 @@ class Engine:
         now = time.time() if now is None else now
         lost = self._lost_peers(now)
         report: Dict = {"peers": {}, "packfiles": 0, "bytes_lost": 0,
-                        "bytes_replaced": 0, "blobs": 0}
+                        "bytes_replaced": 0, "blobs": 0,
+                        "shards_rebuilt": 0}
         if not lost:
             return report
-        # a packfile is orphaned only if EVERY replica is on a lost peer
+        # a packfile is orphaned only if EVERY replica is on a lost peer;
+        # a lost erasure shard whose stripe keeps live holders goes to the
+        # sourceless rebuild path instead (no local source tree needed)
         per_peer: Dict[bytes, list] = {}
         orphaned: Dict[bytes, int] = {}
+        stripe_lost: Dict[bytes, Dict[int, tuple]] = {}
         for peer in lost:
-            rows = self.store.placements_for_peer(peer)
+            rows = self.store.shard_placements_for_peer(peer)
             per_peer[peer] = rows
-            for pid, size in rows:
+            for pid, size, idx in rows:
+                pidb = bytes(pid)
                 holders = {bytes(p)
                            for p in self.store.peers_for_packfile(pid)}
                 if holders <= lost:
-                    orphaned[bytes(pid)] = size
+                    if idx >= 0:
+                        orphaned[pidb] = orphaned.get(pidb, 0) + size
+                    else:
+                        orphaned[pidb] = size
+                elif idx >= 0:
+                    stripe_lost.setdefault(pidb, {})[idx] = (peer, size)
+                # idx < 0 with live holders: another whole replica
+                # survives — nothing to rebuild, the row just retires
+        shards_rebuilt = 0
+        shard_bytes_replaced = 0
+        if stripe_lost:
+            shards_rebuilt, shard_bytes_replaced, unrebuildable = \
+                await self._rebuild_lost_shards(stripe_lost, lost)
+            for pidb in unrebuildable:
+                # fewer than k shards survive and no whole copy: only the
+                # local source can bring the data back — re-pack fallback
+                orphaned[pidb] = orphaned.get(pidb, 0) + sum(
+                    s for _, s in stripe_lost[pidb].values())
         lost_hashes = self.index.forget_packfiles(orphaned)
-        bytes_lost = sum(orphaned.values())
+        bytes_lost = sum(orphaned.values()) + sum(
+            s for pidb, lm in stripe_lost.items() if pidb not in orphaned
+            for _, s in lm.values())
         self._log(f"repair: {len(lost)} lost peer(s), "
                   f"{len(orphaned)} orphaned packfile(s), "
+                  f"{shards_rebuilt} shard(s) rebuilt sourcelessly, "
                   f"{len(lost_hashes)} blob(s) to re-replicate")
         bytes_replaced = 0
         # also run the pipeline when a previous failed round left forgotten
@@ -683,7 +886,7 @@ class Engine:
                 self.store.put_audit_state(replace(
                     st, demoted=True,
                     last_result="dark: placements repaired away"))
-            peer_lost = sum(s for pid, s in per_peer[peer]
+            peer_lost = sum(s for pid, s, _idx in per_peer[peer]
                             if bytes(pid) in orphaned)
             report["peers"][bytes(peer).hex()] = {
                 "placements_retired": retired, "bytes_lost": peer_lost}
@@ -695,13 +898,112 @@ class Engine:
                 self._log(f"repair report for {bytes(peer).hex()[:8]} "
                           f"failed: {e}")
         report.update(packfiles=len(orphaned), bytes_lost=bytes_lost,
-                      bytes_replaced=bytes_replaced, blobs=len(lost_hashes))
+                      bytes_replaced=bytes_replaced + shard_bytes_replaced,
+                      blobs=len(lost_hashes), shards_rebuilt=shards_rebuilt)
         self.store.add_event(EVENT_REPAIR, {
             "peers": [bytes(p).hex() for p in lost],
             "packfiles": len(orphaned), "bytes_lost": bytes_lost,
-            "bytes_replaced": bytes_replaced})
+            "bytes_replaced": bytes_replaced + shard_bytes_replaced,
+            "shards_rebuilt": shards_rebuilt})
         self._log(f"repair complete: {bytes_replaced} bytes re-replicated")
         return report
+
+    async def _rebuild_lost_shards(self, stripe_lost: Dict, lost: set):
+        """Sourceless shard repair: pull each damaged stripe's surviving
+        shards from their holders (the same RESTORE_ALL machinery a full
+        restore uses, staged privately), decode + re-encode the lost rows
+        — byte-identical, so the pre-computed challenge tables stay valid
+        — and place them on fresh peers.  The local source tree is never
+        touched.  Returns ``(shards rebuilt, bytes placed, pids needing
+        the re-pack-from-source fallback)``.
+        """
+        staging = self.store.data_base / "repair_staging"
+        shutil.rmtree(staging, ignore_errors=True)
+        staging.mkdir(parents=True, exist_ok=True)
+        # one pull per surviving holder covers every stripe it touches
+        sources = set()
+        for pidb in stripe_lost:
+            for p, i in self.store.shards_for_packfile(pidb):
+                if i >= 0 and bytes(p) not in lost:
+                    sources.add(bytes(p))
+        writer = RestoreFilesWriter(self.store, base=staging)
+        for peer_id in sorted(sources):
+            try:
+                t = await self.node.connect(
+                    peer_id, wire.RequestType.RESTORE_ALL, timeout=10.0)
+                try:
+                    await Receiver(t, writer.sink).run()
+                finally:
+                    await t.close()
+            except (P2PError, ServerError, OSError,
+                    asyncio.TimeoutError) as e:
+                self._log(f"repair fetch from {peer_id.hex()[:8]}"
+                          f" failed: {e}")
+        rebuilt = 0
+        placed_bytes = 0
+        unrebuildable = []
+        loop = asyncio.get_running_loop()
+        orch = Orchestrator()  # transport bookkeeping for fresh placements
+        try:
+            for pidb, lost_map in stripe_lost.items():
+                shard_dir = staging / "shard" / pidb.hex()
+                blobs = []
+                if shard_dir.is_dir():
+                    blobs = [f.read_bytes()
+                             for f in sorted(shard_dir.iterdir())
+                             if f.is_file()]
+                missing = sorted(lost_map)
+                try:
+                    new_shards = await loop.run_in_executor(
+                        None, rs_stripe.rebuild_shards, blobs, missing,
+                        self.backend)
+                except rs_stripe.StripeError as e:
+                    self._log(f"stripe {pidb.hex()[:8]} not rebuildable:"
+                              f" {e}")
+                    live_whole = any(
+                        i < 0 and bytes(p) not in lost
+                        for p, i in self.store.shards_for_packfile(pidb))
+                    if not live_whole:
+                        unrebuildable.append(pidb)
+                    continue
+                holders = {bytes(p) for p, _i
+                           in self.store.shards_for_packfile(pidb)}
+                conns = await self._get_stripe_connections(
+                    orch, len(missing), holders | lost | self._avoid_peers,
+                    max(len(c) for c in new_shards.values()))
+                placed_here = 0
+                for idx, (transport, peer_id, _free) in zip(missing, conns):
+                    container = new_shards[idx]
+                    self._save_shard_challenge_table(pidb, idx, container)
+                    try:
+                        await transport.send_data(
+                            container, wire.FileInfoKind.SHARD,
+                            rs_stripe.shard_id(pidb, idx))
+                    except P2PError:
+                        await self._drop_transport(orch, peer_id)
+                        continue
+                    self.store.add_peer_transmitted(peer_id, len(container))
+                    self.store.record_placement(
+                        pidb, peer_id, len(container), shard_index=idx)
+                    # the replacement is acked: the dead row can go now
+                    # instead of waiting for the end-of-round retirement
+                    self.store.retire_placement(pidb, lost_map[idx][0])
+                    rebuilt += 1
+                    placed_here += 1
+                    placed_bytes += len(container)
+                if placed_here < len(missing):
+                    self._log(f"stripe {pidb.hex()[:8]}: re-homed only "
+                              f"{placed_here}/{len(missing)} shard(s); "
+                              "stripe stays degraded until peers join")
+                if placed_here and self.messenger is not None:
+                    self.messenger.erasure(pidb.hex(), "rebuilt",
+                                           shards=len(missing),
+                                           rebuilt=placed_here)
+        finally:
+            for peer_id in list(orch.active_transports):
+                await self._drop_transport(orch, peer_id)
+            shutil.rmtree(staging, ignore_errors=True)
+        return rebuilt, placed_bytes, unrebuildable
 
     async def _repack_and_send(self, bytes_lost: int) -> int:
         """Re-pack forgotten blobs from source and send to fresh peers.
@@ -793,6 +1095,10 @@ class Engine:
         for peer_id, res in zip(peers, results):
             if isinstance(res, BaseException):
                 self._log(f"restore from {peer_id.hex()[:8]} failed: {res}")
+        # erasure assembly BEFORE coverage is judged: any k valid shards
+        # of a stripe reconstruct its packfile into the pack tree, so up
+        # to m dark peers per stripe cost nothing
+        await self._assemble_restored_stripes()
         missing = [p for p, done in completed.items() if not done]
         if missing:
             # Failed streams are fatal ONLY if the snapshot is actually
@@ -818,6 +1124,28 @@ class Engine:
         # (backup/mod.rs:180); a failed unpack keeps it for retry/forensics
         shutil.rmtree(self.store.restore_dir(), ignore_errors=True)
         return path
+
+    async def _assemble_restored_stripes(self) -> None:
+        """Rebuild packfiles from erasure shards in the restore staging
+        buffer (restore_dir/shard -> restore_dir/pack); best-effort — a
+        stripe with fewer than k valid shards is logged and surfaces later
+        as a coverage gap, exactly like a missing packfile."""
+        restore_dir = self.store.restore_dir()
+        shard_root = restore_dir / "shard"
+        if not shard_root.is_dir():
+            return
+        done, failed = await asyncio.get_running_loop().run_in_executor(
+            None, rs_stripe.assemble_tree, shard_root,
+            restore_dir / "pack", self.backend)
+        if done:
+            self._log(f"assembled {len(done)} packfile(s) from erasure"
+                      " shards")
+            if self.messenger is not None:
+                self.messenger.erasure("restore", "assembled",
+                                       shards=len(done), rebuilt=len(done))
+        for pid, reason in failed:
+            self._log(f"stripe {bytes(pid).hex()[:8]} not assembled:"
+                      f" {reason}")
 
     def _restored_ctx(self):
         """(index, reader, resolve) over the restore staging buffer."""

@@ -219,11 +219,15 @@ class ServerClient:
 
     # --- typed API (requests.rs) -------------------------------------------
 
-    async def backup_storage_request(self, storage_required: int) -> None:
+    async def backup_storage_request(self, storage_required: int,
+                                     min_peers: int = 1) -> None:
+        """``min_peers > 1`` asks the matchmaker to spread the grant over
+        that many distinct candidates (erasure stripes need k+m holders)."""
         await self._with_login(lambda t: self._post(
             "/backups/request",
             wire.BackupRequest(session_token=t,
-                               storage_required=storage_required)))
+                               storage_required=storage_required,
+                               min_peers=min_peers)))
 
     async def backup_done(self, snapshot_hash: bytes) -> None:
         await self._with_login(lambda t: self._post(

@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import os
 import time
+from pathlib import Path
 from typing import Callable, Dict, Optional
 
 import numpy as np
@@ -267,7 +268,12 @@ class ReceivedFilesWriter:
 
     async def sink(self, file_info: wire.FileInfoKind, file_id: bytes,
                    data: bytes) -> None:
-        sub = "index" if file_info == wire.FileInfoKind.INDEX else "pack"
+        if file_info == wire.FileInfoKind.INDEX:
+            sub = "index"
+        elif file_info == wire.FileInfoKind.SHARD:
+            sub = "shard"  # file_id is the 13-byte shard id
+        else:
+            sub = "pack"
         d = self.dir / sub
         d.mkdir(parents=True, exist_ok=True)
         path = d / bytes(file_id).hex()
@@ -292,6 +298,7 @@ class ReceivedFilesWriter:
         """Yield (file_info, file_id, de-obfuscated bytes) of everything this
         peer stored with us — the restore-serving source (restore_send.rs)."""
         for sub, kind in (("pack", wire.FileInfoKind.PACKFILE),
+                          ("shard", wire.FileInfoKind.SHARD),
                           ("index", wire.FileInfoKind.INDEX)):
             d = self.dir / sub
             if not d.is_dir():
@@ -302,11 +309,13 @@ class ReceivedFilesWriter:
 
 
 class RestoreFilesWriter:
-    """Save own packfiles coming back from a peer during restore
-    (restore_files_writer.rs)."""
+    """Save own packfiles/shards coming back from a peer during restore
+    (restore_files_writer.rs).  ``base`` overrides the destination tree —
+    sourceless shard repair stages its survivor fetches in a scratch dir
+    instead of the restore dir."""
 
-    def __init__(self, store: Store):
-        self.dir = store.restore_dir()
+    def __init__(self, store: Store, base: Optional[object] = None):
+        self.dir = Path(base) if base is not None else store.restore_dir()
         self.files = 0
 
     async def sink(self, file_info: wire.FileInfoKind, file_id: bytes,
@@ -314,6 +323,12 @@ class RestoreFilesWriter:
         if file_info == wire.FileInfoKind.INDEX:
             d = self.dir / "index"
             name = f"{int.from_bytes(bytes(file_id)[:8], 'little'):06d}"
+        elif file_info == wire.FileInfoKind.SHARD:
+            # shard/<packfile hex>/<index>: one directory per stripe so
+            # assembly (erasure/stripe.py assemble_tree) can walk it
+            pid, idx = bytes(file_id)[:-1], bytes(file_id)[-1]
+            d = self.dir / "shard" / pid.hex()
+            name = f"{idx:03d}"
         else:
             d = self.dir / "pack" / bytes(file_id).hex()[:2]
             name = bytes(file_id).hex()

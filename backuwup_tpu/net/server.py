@@ -346,13 +346,31 @@ class StorageQueue:
                 return client, remaining, expires
         return None
 
-    async def fulfill(self, client_id: bytes, storage_required: int) -> None:
+    async def fulfill(self, client_id: bytes, storage_required: int,
+                      min_peers: int = 1) -> None:
         """Match against queued requests; both sides get BackupMatched for
         min(remaining, candidate); remainders re-enqueue
-        (backup_request.rs:73-185)."""
+        (backup_request.rs:73-185).
+
+        ``min_peers > 1`` is the erasure-stripe hint: the requester wants
+        its grant spread over at least that many DISTINCT peers (a stripe
+        needs k+m holders), so each match is capped at an even share
+        instead of letting one storage-rich candidate swallow the whole
+        request.  The cap only applies while the queue holds enough other
+        candidates to plausibly reach the spread — with a shallower queue
+        it falls back to greedy matching, so 2–3-client deployments see
+        exactly the pre-erasure behavior.
+        """
         if storage_required > defaults.MAX_BACKUP_STORAGE_REQUEST_SIZE:
             raise ValueError("storage request exceeds protocol cap")
+        min_peers = max(int(min_peers), 1)
         async with self._lock:
+            share_cap = None
+            if min_peers > 1:
+                others = {c for c, _r, _e in self._queue
+                          if c != bytes(client_id)}
+                if len(others) >= min_peers:
+                    share_cap = -(-storage_required // min_peers)
             remaining = storage_required
             while remaining > 0:
                 entry = self._pop_valid()
@@ -368,6 +386,8 @@ class StorageQueue:
                     # drop its queued request rather than hand it new data.
                     continue
                 match = min(remaining, cand_remaining)
+                if share_cap is not None:
+                    match = min(match, share_cap)
                 # Record the negotiation FIRST, then push: a client must
                 # never learn of a match the server does not persist (a
                 # notified candidate would start treating the requester as a
@@ -518,7 +538,8 @@ class CoordinationServer:
         msg = await self._parse(request, wire.BackupRequest)
         client = self._session(msg)
         try:
-            await self.queue.fulfill(client, msg.storage_required)
+            await self.queue.fulfill(client, msg.storage_required,
+                                     min_peers=msg.min_peers)
         except ValueError as e:
             raise self._err(wire.ErrorKind.BAD_REQUEST, str(e))
         return self._ok()
@@ -537,8 +558,13 @@ class CoordinationServer:
             # NoBackupsAvailable -> 404 NoBackups (handlers/backup.rs:30-38)
             raise self._err(wire.ErrorKind.NO_BACKUPS)
         peers = self.db.get_client_negotiated_peers(client)
+        # advertise the deployment's stripe geometry so a from-scratch
+        # restore client knows how many peer streams can go dark before
+        # coverage is actually at risk (the shard containers themselves
+        # are self-describing; this is advisory)
         return self._ok(wire.BackupRestoreInfo(
-            snapshot_hash=snapshot, peers=[p.hex() for p in peers]))
+            snapshot_hash=snapshot, peers=[p.hex() for p in peers],
+            rs_k=defaults.RS_K, rs_m=defaults.RS_M))
 
     async def p2p_begin(self, request):
         msg = await self._parse(request, wire.BeginP2PConnectionRequest)

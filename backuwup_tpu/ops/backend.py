@@ -20,6 +20,9 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence
 
+import numpy as np
+
+from ..erasure import gf_cpu
 from .blake3_cpu import blake3_many
 from .blake3_tpu import blake3_many_tpu
 from .cdc_cpu import chunk_stream as chunk_stream_cpu
@@ -46,6 +49,29 @@ class ChunkerBackend:
 
     def digest_many(self, datas: Sequence[bytes]) -> List[bytes]:
         raise NotImplementedError
+
+    # --- erasure coding (erasure/; same routing pattern as digest_many:
+    # the numpy oracle is the default, TpuBackend overrides with the
+    # batched device kernel, and both are bit-identical) ------------------
+
+    def encode_shards(self, stripes, m: int):
+        """Reed-Solomon parity: (B, k, L) data shards -> (B, m, L)."""
+        stripes = np.asarray(stripes, dtype=np.uint8)
+        b, k, ln = stripes.shape
+        if m == 0 or b == 0:
+            return np.zeros((b, m, ln), dtype=np.uint8)
+        parity_rows = gf_cpu.generator_matrix(k, m)[k:]
+        return np.stack([gf_cpu.gf_matmul(parity_rows, s) for s in stripes])
+
+    def decode_shards(self, stripes, k: int, m: int, present):
+        """Recover data shards from survivors: ``stripes`` is (B, k, L)
+        with rows ordered by the sorted ``present`` indices."""
+        stripes = np.asarray(stripes, dtype=np.uint8)
+        if stripes.shape[0] == 0:
+            return stripes
+        cols = sorted(set(int(i) for i in present))
+        rec = gf_cpu.decode_matrix(k, m, cols)[:, cols]
+        return np.stack([gf_cpu.gf_matmul(rec, s) for s in stripes])
 
     def manifest_many(self, streams: Sequence[bytes]) -> List[List[ChunkRef]]:
         """Chunk + fingerprint a batch of streams in one pipeline pass."""
@@ -181,6 +207,14 @@ class TpuBackend(ChunkerBackend):
 
     def digest_many(self, datas):
         return blake3_many_tpu(datas)
+
+    def encode_shards(self, stripes, m):
+        from ..erasure import rs_tpu
+        return rs_tpu.encode_stripes(stripes, m)
+
+    def decode_shards(self, stripes, k, m, present):
+        from ..erasure import rs_tpu
+        return rs_tpu.decode_stripes(stripes, k, m, present)
 
     def manifest_many(self, streams):
         results = self.pipeline.manifest_batch(streams)

@@ -84,9 +84,12 @@ class ChallengeTable:
 
     def save(self, packfile_id: bytes,
              entries: Iterable[ChallengeEntry]) -> Path:
+        # id is a 12-byte packfile id or a 13-byte shard id (packfile id +
+        # index byte, erasure/stripe.py); both are unique and both work as
+        # the GCM nonce (lengths != 12 go through EVP_CTRL_GCM_SET_IVLEN)
         pid = bytes(packfile_id)
-        if len(pid) != PACKFILE_ID_LEN:
-            raise ValueError("bad packfile id length")
+        if len(pid) not in (PACKFILE_ID_LEN, PACKFILE_ID_LEN + 1):
+            raise ValueError("bad packfile/shard id length")
         path = self.path(pid)
         if path.exists():
             raise FileExistsError(

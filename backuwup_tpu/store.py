@@ -54,6 +54,7 @@ CREATE TABLE IF NOT EXISTS placements (
     peer BLOB NOT NULL,
     size INTEGER NOT NULL,
     sent_at REAL NOT NULL,
+    shard_index INTEGER NOT NULL DEFAULT -1,
     PRIMARY KEY (packfile_id, peer)
 );
 CREATE TABLE IF NOT EXISTS audit_ledger (
@@ -132,6 +133,14 @@ class Store:
         self._db = sqlite3.connect(self.dir / "config.db",
                                    check_same_thread=False)
         self._db.executescript(_SCHEMA)
+        # erasure-era column on pre-existing databases; -1 = whole packfile
+        # (the CREATE above already carries it for fresh stores)
+        try:
+            self._db.execute(
+                "ALTER TABLE placements ADD COLUMN"
+                " shard_index INTEGER NOT NULL DEFAULT -1")
+        except sqlite3.OperationalError:
+            pass  # already present
         self._db.commit()
 
     def close(self) -> None:
@@ -283,20 +292,28 @@ class Store:
         avoid = self.demoted_peers() | {bytes(p) for p in exclude}
         peers = [p for p in self.list_peers()
                  if p.free_storage > 0 and p.pubkey not in avoid]
-        peers.sort(key=lambda p: p.free_storage, reverse=True)
+        # deterministic tie-break: free space desc, then pubkey — shard
+        # placement must be reproducible under the seeded fault plane
+        peers.sort(key=lambda p: (-p.free_storage, p.pubkey))
         return peers
 
     # --- packfile placements (verifier's who-holds-what map) ----------------
 
     def record_placement(self, packfile_id: bytes, peer: bytes, size: int,
-                         now: Optional[float] = None) -> None:
+                         now: Optional[float] = None,
+                         shard_index: int = -1) -> None:
+        """``shard_index`` -1 = the peer holds the whole packfile; >= 0 =
+        it holds that one erasure shard of the stripe.  The (packfile_id,
+        peer) key enforces one shard per peer per stripe."""
         now = time.time() if now is None else now
         with self._lock:
             self._db.execute(
-                "INSERT INTO placements (packfile_id, peer, size, sent_at)"
-                " VALUES (?, ?, ?, ?)"
+                "INSERT INTO placements"
+                " (packfile_id, peer, size, sent_at, shard_index)"
+                " VALUES (?, ?, ?, ?, ?)"
                 " ON CONFLICT(packfile_id, peer) DO NOTHING",
-                (bytes(packfile_id), bytes(peer), int(size), now))
+                (bytes(packfile_id), bytes(peer), int(size), now,
+                 int(shard_index)))
             self._db.commit()
 
     def placements_for_peer(self, peer: bytes) -> list:
@@ -306,6 +323,35 @@ class Store:
                 "SELECT packfile_id, size FROM placements WHERE peer = ?"
                 " ORDER BY sent_at", (bytes(peer),)).fetchall()
         return [(bytes(r[0]), int(r[1])) for r in rows]
+
+    def shard_placements_for_peer(self, peer: bytes) -> list:
+        """[(packfile_id, size, shard_index)] held by ``peer``, oldest
+        first; shard_index -1 means the whole packfile."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT packfile_id, size, shard_index FROM placements"
+                " WHERE peer = ? ORDER BY sent_at",
+                (bytes(peer),)).fetchall()
+        return [(bytes(r[0]), int(r[1]), int(r[2])) for r in rows]
+
+    def shards_for_packfile(self, packfile_id: bytes) -> list:
+        """[(peer, shard_index)] across the stripe (or [(peer, -1)] rows
+        for whole-packfile replication)."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT peer, shard_index FROM placements"
+                " WHERE packfile_id = ?", (bytes(packfile_id),)).fetchall()
+        return [(bytes(r[0]), int(r[1])) for r in rows]
+
+    def retire_placement(self, packfile_id: bytes, peer: bytes) -> int:
+        """Drop one (packfile, peer) placement row — sourceless shard
+        repair retires exactly the lost shard rows it re-homed."""
+        with self._lock:
+            cur = self._db.execute(
+                "DELETE FROM placements WHERE packfile_id = ? AND peer = ?",
+                (bytes(packfile_id), bytes(peer)))
+            self._db.commit()
+        return cur.rowcount
 
     def peers_with_placements(self) -> list:
         with self._lock:

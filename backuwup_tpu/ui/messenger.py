@@ -101,6 +101,15 @@ class Messenger:
                                          "detail": detail,
                                          "demoted": demoted}))
 
+    def erasure(self, subject: str, outcome: str, shards: int = 0,
+                rebuilt: int = 0) -> None:
+        """Erasure-coding telemetry frame (outcome: placed | assembled |
+        rebuilt); ``subject`` is a packfile id hex or a phase label."""
+        self._emit(StatusEvent("erasure", {"subject": subject,
+                                           "outcome": outcome,
+                                           "shards": shards,
+                                           "rebuilt": rebuilt}))
+
     def error(self, text: str) -> None:
         self._emit(StatusEvent("error", {"text": text}))
 

@@ -25,6 +25,7 @@ from .utils.serialization import Reader, Writer
 CLIENT_ID_LEN = 32  # Ed25519 public key doubles as the client identity
 BLOB_HASH_LEN = 32  # blake3 digest
 PACKFILE_ID_LEN = 12  # doubles as the packfile header AES-GCM nonce
+SHARD_ID_LEN = PACKFILE_ID_LEN + 1  # packfile id + erasure shard index byte
 SESSION_TOKEN_LEN = 16
 TRANSPORT_NONCE_LEN = 16
 CHALLENGE_NONCE_LEN = 32
@@ -34,6 +35,17 @@ AUDIT_NONCE_LEN = 16  # per-window keyed-digest nonce (storage attestation)
 def _check(name: str, value: bytes, length: int) -> bytes:
     if not isinstance(value, (bytes, bytearray)) or len(value) != length:
         raise ValueError(f"{name} must be exactly {length} bytes, got {value!r:.60}")
+    return bytes(value)
+
+
+def _check_storage_id(name: str, value: bytes) -> bytes:
+    """A storage-plane object id: a whole packfile (12 bytes) or one
+    erasure shard (packfile id + index byte, 13 bytes)."""
+    if (not isinstance(value, (bytes, bytearray))
+            or len(value) not in (PACKFILE_ID_LEN, SHARD_ID_LEN)):
+        raise ValueError(
+            f"{name} must be {PACKFILE_ID_LEN} or {SHARD_ID_LEN} bytes, "
+            f"got {value!r:.60}")
     return bytes(value)
 
 
@@ -251,8 +263,12 @@ class ClientLoginAuth(JsonMessage):
 
 @dataclass
 class BackupRequest(JsonMessage):
+    # min_peers > 1 asks matchmaking to spread the grant over at least
+    # that many distinct peers (erasure stripes need k+m distinct
+    # holders); 1 keeps the reference's fill-greedily behavior.
     session_token: bytes
     storage_required: int
+    min_peers: int = 1
     _bytes_fields = {"session_token": SESSION_TOKEN_LEN}
 
 
@@ -343,8 +359,13 @@ class LoginToken(JsonMessage):
 
 @dataclass
 class BackupRestoreInfo(JsonMessage):
+    # rs_k/rs_m advertise the erasure geometry the cluster runs (0 = the
+    # server predates sharding); shard containers are self-describing, so
+    # these are informational for the restoring client's planning only.
     snapshot_hash: Optional[bytes] = None
     peers: list = field(default_factory=list)  # hex client ids
+    rs_k: int = 0
+    rs_m: int = 0
     _bytes_fields = {"snapshot_hash": BLOB_HASH_LEN}
 
 
@@ -445,10 +466,13 @@ class RequestType(IntEnum):
 
 
 class FileInfoKind(IntEnum):
-    """p2p_message.rs:51-54."""
+    """p2p_message.rs:51-54 (SHARD added for erasure-coded placement:
+    the file_id is a 13-byte shard id and the payload a self-describing
+    shard container, erasure/stripe.py)."""
 
     PACKFILE = 0
     INDEX = 1
+    SHARD = 2
 
 
 @dataclass(frozen=True)
@@ -487,7 +511,9 @@ class ProofStatus(IntEnum):
 class StorageChallenge:
     """One random-window audit challenge: prove possession of
     ``packfile_id[offset:offset+length]`` by returning
-    blake3(nonce || window-bytes)."""
+    blake3(nonce || window-bytes).  The id names a whole packfile
+    (12 bytes) or a single erasure shard (13 bytes), so the id is
+    length-prefixed on the wire."""
 
     packfile_id: bytes
     offset: int
@@ -495,18 +521,18 @@ class StorageChallenge:
     nonce: bytes
 
     def __post_init__(self) -> None:
-        _check("challenge packfile id", self.packfile_id, PACKFILE_ID_LEN)
+        _check_storage_id("challenge packfile id", self.packfile_id)
         _check("challenge nonce", self.nonce, AUDIT_NONCE_LEN)
 
     def encode(self, w: Writer) -> None:
-        w.fixed(self.packfile_id)
+        w.blob(self.packfile_id)
         w.u64(self.offset)
         w.u64(self.length)
         w.fixed(self.nonce)
 
     @classmethod
     def decode(cls, r: Reader) -> "StorageChallenge":
-        return cls(packfile_id=r.fixed(PACKFILE_ID_LEN), offset=r.u64(),
+        return cls(packfile_id=r.blob(), offset=r.u64(),
                    length=r.u64(), nonce=r.fixed(AUDIT_NONCE_LEN))
 
 
@@ -520,17 +546,17 @@ class StorageProof:
     digest: bytes = b"\x00" * BLOB_HASH_LEN
 
     def __post_init__(self) -> None:
-        _check("proof packfile id", self.packfile_id, PACKFILE_ID_LEN)
+        _check_storage_id("proof packfile id", self.packfile_id)
         _check("proof digest", self.digest, BLOB_HASH_LEN)
 
     def encode(self, w: Writer) -> None:
-        w.fixed(self.packfile_id)
+        w.blob(self.packfile_id)
         w.u32(int(self.status))
         w.fixed(self.digest)
 
     @classmethod
     def decode(cls, r: Reader) -> "StorageProof":
-        return cls(packfile_id=r.fixed(PACKFILE_ID_LEN),
+        return cls(packfile_id=r.blob(),
                    status=ProofStatus(r.u32()),
                    digest=r.fixed(BLOB_HASH_LEN))
 

@@ -524,6 +524,60 @@ def config6_end_to_end(log: Callable) -> Dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def config7_erasure(log: Callable) -> Dict:
+    """Reed-Solomon shard encode/decode throughput — BASELINE config #7.
+
+    Times the erasure subsystem's hot path (``backend.encode_shards`` /
+    ``decode_shards``: table-lookup GF(2^8) matmul + XOR-reduce under
+    jit(vmap) on device, numpy oracle on CPU) over batches of RS_K+RS_M
+    stripes.  Decode reconstructs from the WORST-case survivor set (all
+    parity shards in play) so the recovery-matrix solve is real work, and
+    the gate demands bit-identical output: encode must match the gf_cpu
+    oracle, decode must reproduce the original data shards exactly.
+    """
+    from backuwup_tpu import defaults
+    from backuwup_tpu.erasure import gf_cpu
+    from backuwup_tpu.ops.backend import select_backend
+
+    k, m = int(defaults.RS_K), int(defaults.RS_M)
+    shard_kib = int(os.environ.get("BENCH_C7_SHARD_KIB", "512"))
+    batch = int(os.environ.get("BENCH_C7_STRIPES", "64"))
+    backend = select_backend()
+    ln = shard_kib << 10
+    rng = np.random.default_rng(71)
+    stripes = rng.integers(0, 256, (batch, k, ln), dtype=np.uint8)
+
+    # parity + round-trip gate on one stripe before anything is timed
+    parity = np.asarray(backend.encode_shards(stripes, m), dtype=np.uint8)
+    ref = gf_cpu.gf_matmul(gf_cpu.generator_matrix(k, m)[k:], stripes[0])
+    if not np.array_equal(parity[0], ref):
+        raise RuntimeError("config #7: encode parity FAILED vs gf_cpu")
+    present = list(range(m, k + m))  # first m data shards "lost"
+    full = np.concatenate([stripes, parity], axis=1)
+    surv = full[:, present, :]
+    decoded = np.asarray(backend.decode_shards(surv, k, m, present),
+                         dtype=np.uint8)
+    if not np.array_equal(decoded, stripes):
+        raise RuntimeError("config #7: decode round-trip FAILED")
+
+    data_mib = batch * k * ln / (1 << 20)
+    window = SustainedWindow()
+    for _ in window.passes():
+        p = np.asarray(backend.encode_shards(stripes, m))
+        np.asarray(backend.decode_shards(surv, k, m, present))
+        del p
+    passes = window.count
+    dt = window.wall
+    # each pass encodes AND decodes the full batch of stripes
+    enc_dec_mibs = passes * 2 * data_mib / dt
+    log(f"config#7 erasure rs({k},{m}): {passes}x{data_mib:.0f} MiB "
+        f"enc+dec in {dt:.2f}s = {enc_dec_mibs:.1f} MiB/s "
+        f"({backend.name} backend)")
+    return {"mib_s": round(enc_dec_mibs, 2), "rs_k": k, "rs_m": m,
+            "shard_kib": shard_kib, "backend": backend.name,
+            "wall_s": round(dt, 2)}
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -534,7 +588,13 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
                                                           log)),
             ("4_large_stream_64k", lambda: config4_large_stream(log)),
             ("5_cross_peer_dedup", lambda: config5_cross_peer(log)),
-            ("6_end_to_end", lambda: config6_end_to_end(log))):
+            ("6_end_to_end", lambda: config6_end_to_end(log)),
+            ("7_erasure", lambda: config7_erasure(log))):
+        # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
+        # tpu_watch.sh recapture path re-measures just "7_erasure")
+        only = os.environ.get("BENCH_ONLY_CONFIG", "")
+        if only and only not in name:
+            continue
         try:
             out[name] = fn()
             if "mib_s" in out[name]:

@@ -17,6 +17,17 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "bench recapture FAILED (see $out) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated erasure recapture: config #7 alone on a short window,
+        # so the RS encode/decode number exists even when the full suite
+        # above timed out partway
+        ers="$BENCH_OUT_DIR/BENCH_erasure_${stamp}.json"
+        if timeout "${BENCH_ERASURE_TIMEOUT_S:-600}" \
+                env BENCH_ONLY_CONFIG=7_erasure BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$ers" 2>>/tmp/tpu_watch.log; then
+            echo "erasure bench recaptured to $ers at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "erasure bench recapture FAILED (see $ers) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

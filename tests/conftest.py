@@ -51,6 +51,24 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "timeout(seconds): per-test watchdog override for the"
         " conftest SIGALRM watchdog")
+    config.addinivalue_line(
+        "markers", "accel: needs a real accelerator backend; skipped"
+        " cleanly when jax runs on the host platform (tier-1 pins"
+        " JAX_PLATFORMS=cpu)")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Device-only tests (``@pytest.mark.accel``) skip on the CPU host
+    platform instead of failing — mirroring the runtime-probe skip the
+    blake3 device tests use, but declaratively."""
+    import jax
+    if jax.default_backend() != "cpu":
+        return
+    skip = pytest.mark.skip(reason="accel-marked test: no accelerator"
+                            " backend (JAX_PLATFORMS=cpu)")
+    for item in items:
+        if item.get_closest_marker("accel"):
+            item.add_marker(skip)
 
 
 @pytest.hookimpl(hookwrapper=True)
