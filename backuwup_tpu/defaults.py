@@ -73,6 +73,24 @@ PEER_DARK_DEADLINE_S = 3 * 24 * 3600.0
 RS_K = 4
 RS_M = 2
 
+# --- concurrent transfer plane (net/transfer.py, docs/transfer.md; no
+# reference equivalent — send.rs transmits strictly one file at a time) -------
+# Uploads admitted concurrently across all peers; per-peer ordering is
+# still serialized (the signed transport sequence demands it).
+TRANSFER_MAX_INFLIGHT = 8
+# Distinct peers the whole-packfile path fans out to per send tick (the
+# stripe path always uses one peer per missing shard).
+TRANSFER_MAX_PEERS = 4
+# In-flight payload RAM cap; a single transfer larger than the cap is
+# still admitted when the plane is empty (no deadlock on oversize files).
+TRANSFER_INFLIGHT_BYTE_CAP = 64 * MiB
+# Packfile seal pipeline (snapshot/packfile.py): worker threads running
+# zstd + AES-GCM (both release the GIL) and the bound on
+# assembled-but-unwritten packfile batches (double buffering).  0 workers
+# = the original synchronous seal-in-add_blob behavior.
+PACK_SEAL_WORKERS = 2
+PACK_SEAL_QUEUE_PACKFILES = 2
+
 # --- protocol limits (reference shared/src/constants.rs:4-7) ----------------
 MAX_BACKUP_STORAGE_REQUEST_SIZE = 16 * GiB
 BACKUP_REQUEST_EXPIRY_S = 300.0

@@ -48,6 +48,7 @@ from .erasure import gf_cpu
 from .erasure import stripe as rs_stripe
 from .net.client import NoBackups, ServerClient, ServerError
 from .net.p2p import P2PError, P2PNode, Receiver, RestoreFilesWriter, Transport
+from .net.transfer import TransferScheduler
 from .ops.backend import ChunkerBackend, select_backend
 from .snapshot.blob_index import BlobIndex, ChallengeTable
 from .snapshot.packer import DirPacker
@@ -145,6 +146,8 @@ class Engine:
         self.auto_repair = True
         self._repair_task: Optional[asyncio.Task] = None
         self._avoid_peers: set = set()
+        # transfer plane of the most recent send loop (telemetry seam)
+        self._transfers: Optional[TransferScheduler] = None
 
     @staticmethod
     def _default_mesh():
@@ -215,6 +218,12 @@ class Engine:
     def _buffer_bytes(self) -> int:
         return sum(s for _, _, s in self._unsent_packfiles())
 
+    @staticmethod
+    async def _blocking(fn, *args):
+        """Run blocking disk I/O on the executor: the send/stripe/repair
+        paths must never stall the event loop on a read/unlink/scan."""
+        return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
     # --- backup ------------------------------------------------------------
 
     async def run_backup(self, root: Optional[Path] = None) -> bytes:
@@ -240,16 +249,21 @@ class Engine:
         def pack_thread() -> None:
             writer = PackfileWriter(
                 self.keys, self._pack_dir(),
-                on_packfile=self._on_packfile_threadsafe(loop))
+                on_packfile=self._on_packfile_threadsafe(loop),
+                seal_workers=defaults.PACK_SEAL_WORKERS)
             packer = DirPacker(self.backend, writer, self.index,
                                progress=self._pack_progress,
                                should_pause=orch.block_if_paused,
                                dedup_batch=(self.device_dedup.classify_insert
                                             if self.device_dedup else None))
-            with tracing.span("engine.pack"), \
-                    tracing.jax_profiler("backup_pack"):
-                snapshot_holder["hash"] = packer.pack(root)
-            snapshot_holder["stats"] = packer.stats
+            try:
+                with tracing.span("engine.pack"), \
+                        tracing.jax_profiler("backup_pack"):
+                    snapshot_holder["hash"] = packer.pack(root)
+                snapshot_holder["stats"] = packer.stats
+                snapshot_holder["seal"] = dict(writer.stage_seconds)
+            finally:
+                writer.shutdown()
 
         pack_fut = loop.run_in_executor(None, pack_thread)
         send_task = asyncio.create_task(self._send_loop(orch, estimate))
@@ -272,6 +286,15 @@ class Engine:
             "size": snapshot_holder["stats"].bytes_read,
             "snapshot": snapshot.hex()})
         self._log(f"backup finished: {snapshot.hex()}")
+        if self.messenger is not None:
+            stages = dict(snapshot_holder.get("seal") or {})
+            stages["chunk_hash"] = getattr(
+                self.last_pack_stats, "chunk_hash_s", 0.0)
+            if self._transfers is not None:
+                stages["send"] = self._transfers.stage_s["send"]
+                stages["send_wait"] = self._transfers.stage_s["wait"]
+            self.messenger.transfer("engine", "summary",
+                                    size=orch.bytes_sent, stages=stages)
         if tracing.enabled():
             self._log("trace spans:\n" + tracing.format_report())
         return snapshot
@@ -304,6 +327,11 @@ class Engine:
 
     async def _send_loop(self, orch: Orchestrator, estimate: int) -> None:
         fulfilled = 0
+        # the concurrent transfer plane: bounded in-flight bytes, per-peer
+        # ordering, per-transfer failure isolation (net/transfer.py).  One
+        # scheduler per send loop so serial/concurrent knobs re-read
+        # defaults each run.
+        sched = self._transfers = TransferScheduler(messenger=self.messenger)
         # unified retry shapes (utils/retry.py): the storage re-request
         # backs off across consecutive dry spells, the two pacing waits
         # grow toward their caps while idle and reset on progress
@@ -326,21 +354,21 @@ class Engine:
                     continue
                 # counter says drained: confirm with one real scan before
                 # finishing (the counter is advisory, the dir is truth)
-                unsent = self._unsent_packfiles()
+                unsent = await self._blocking(self._unsent_packfiles)
                 if not unsent:
                     break
                 orch.set_buffer(sum(s for _, _, s in unsent))
             else:
-                unsent = self._unsent_packfiles()
+                unsent = await self._blocking(self._unsent_packfiles)
                 if not unsent:
                     orch.set_buffer(0)
                     continue
             pack_wait.reset()
             # erasure-first: any packfile that can reach RS_K+RS_M distinct
             # peers right now goes out as a shard stripe; the rest fall
-            # through to the single-peer whole-file path below, so small
-            # swarms behave exactly as before sharding existed
-            unsent, striped = await self._send_stripes(orch, unsent)
+            # through to the whole-file path below, so small swarms behave
+            # exactly as before sharding existed
+            unsent, striped = await self._send_stripes(orch, sched, unsent)
             if striped:
                 fulfilled += striped
                 request_timer.reset()
@@ -358,34 +386,78 @@ class Engine:
                 continue
             peer_wait.reset()
             request_timer.reset()
-            sent_any = False
-            for pid, path, size in unsent:
-                if size > peer_free + defaults.PEER_OVERUSE_GRACE // 2:
-                    # Skip, don't stop: unsent is in directory order, so a
-                    # large packfile sorting first must not starve smaller
-                    # ones that still fit this peer (the peer qualified on
-                    # min_free, the smallest unsent file).
-                    continue
-                try:
-                    await transport.send_data(path.read_bytes(),
-                                              wire.FileInfoKind.PACKFILE, pid)
-                except P2PError:
-                    await self._drop_transport(orch, peer_id)
-                    break
-                path.unlink()  # delete only after ack (send.rs:277-289)
-                self.store.add_peer_transmitted(peer_id, size)
-                self.store.record_placement(pid, peer_id, size)
-                orch.bytes_sent += size
-                orch.adjust_buffer(-size)
-                peer_free -= size
-                fulfilled += size
-                sent_any = True
-                self._progress(bytes_transmitted=orch.bytes_sent)
-            if not sent_any:
+            sent = await self._send_whole_files(
+                orch, sched, unsent, (transport, bytes(peer_id), peer_free))
+            if sent:
+                fulfilled += sent
+            else:
                 await self._drop_transport(orch, peer_id)
                 await peer_wait.sleep()
         # index files last, watermarked (send.rs:135-176)
         await self._send_index_files(orch, estimate, fulfilled)
+
+    async def _send_whole_files(self, orch: Orchestrator,
+                                sched: TransferScheduler, unsent: list,
+                                first_conn) -> int:
+        """Whole-packfile fan-out: distribute ``unsent`` over up to
+        TRANSFER_MAX_PEERS connected peers and put every assigned file in
+        flight concurrently (per-peer ordering preserved by the plane).
+        Returns bytes acked; failed peers are dropped, their files stay
+        on disk for the next tick.
+        """
+        # allowance-tracked connections, the qualifying peer first
+        conns = [[first_conn[0], bytes(first_conn[1]), first_conn[2]]]
+        if len(unsent) > 1 and defaults.TRANSFER_MAX_PEERS > 1:
+            extra = await self._get_stripe_connections(
+                orch, min(defaults.TRANSFER_MAX_PEERS, len(unsent)) - 1,
+                {conns[0][1]} | self._avoid_peers,
+                min(s for _, _, s in unsent))
+            conns += [[t, bytes(p), free] for t, p, free in extra
+                      if bytes(p) != conns[0][1]]
+        tasks = []
+        for pid, path, size in unsent:
+            # Most-free connection that can take it; skip, don't stop:
+            # unsent is in directory order, so a large packfile sorting
+            # first must not starve smaller ones that still fit some peer
+            # (the first peer qualified on min_free, the smallest file).
+            best = None
+            for c in conns:
+                if size <= c[2] + defaults.PEER_OVERUSE_GRACE // 2 and (
+                        best is None or c[2] > best[2]):
+                    best = c
+            if best is None:
+                continue
+            best[2] -= size
+            tasks.append(sched.submit(
+                best[1], size,
+                self._whole_file_job(orch, best[0], best[1], pid, path, size),
+                label=f"pack:{bytes(pid).hex()[:8]}"))
+        sent = 0
+        dropped = set()
+        for r in await sched.gather(tasks):
+            if r.ok:
+                sent += r.size
+            elif isinstance(r.error, P2PError) and r.peer_id not in dropped:
+                dropped.add(r.peer_id)
+                await self._drop_transport(orch, r.peer_id)
+        return sent
+
+    def _whole_file_job(self, orch: Orchestrator, transport, peer_id: bytes,
+                        pid: bytes, path: Path, size: int):
+        """One scheduled transfer: read off-loop, send, then post-ack
+        bookkeeping.  An OSError on the read is isolated to this transfer
+        (the file is retried next tick), not a peer failure."""
+        async def job() -> None:
+            data = await self._blocking(path.read_bytes)
+            await transport.send_data(data, wire.FileInfoKind.PACKFILE, pid)
+            # delete only after ack (send.rs:277-289)
+            await self._blocking(path.unlink)
+            self.store.add_peer_transmitted(peer_id, size)
+            self.store.record_placement(pid, peer_id, size)
+            orch.bytes_sent += size
+            orch.adjust_buffer(-size)
+            self._progress(bytes_transmitted=orch.bytes_sent)
+        return job
 
     # --- erasure-coded stripe placement (erasure/) --------------------------
 
@@ -401,17 +473,21 @@ class Engine:
             return None
         return k, m
 
-    async def _send_stripes(self, orch: Orchestrator, unsent: list):
+    async def _send_stripes(self, orch: Orchestrator,
+                            sched: TransferScheduler, unsent: list):
         """Place unsent packfiles as k+m shard stripes on distinct peers.
 
         Per packfile: skip shard indices already placed (deterministic
         encode makes re-sends byte-identical, so a retry after a crash or
         a dead peer resumes the same stripe), acquire one fresh transport
-        per missing shard, and delete the local file only once all k+m
-        shards are acked.  Returns (files for the legacy whole-file path,
-        bytes fully placed).  A packfile that already has a whole-file
-        placement, or that cannot reach enough distinct peers this tick,
-        is handed back for the legacy path — never stranded.
+        per missing shard, put **every missing shard in flight
+        concurrently** — each to its own peer, so the stripe's wall clock
+        is bounded by the slowest single shard, not the sum — and delete
+        the local file only once all k+m shards are acked.  Returns
+        (files for the legacy whole-file path, bytes fully placed).  A
+        packfile that already has a whole-file placement, or that cannot
+        reach enough distinct peers this tick, is handed back for the
+        legacy path — never stranded.
         """
         geom = self._stripe_geometry()
         if geom is None:
@@ -435,7 +511,7 @@ class Engine:
             missing = [i for i in range(n) if i not in holders]
             if not missing:
                 # fully placed by an earlier interrupted run
-                self._finish_stripe(orch, pid, path, size)
+                await self._finish_stripe(orch, pid, path, size)
                 placed_bytes += size
                 continue
             shard_size = rs_stripe.HEADER_LEN + gf_cpu.shard_len(size, k)
@@ -446,30 +522,41 @@ class Engine:
                 leftover.append((pid, path, size))
                 continue
             try:
-                data = path.read_bytes()
-            except OSError:
+                data = await self._blocking(path.read_bytes)
+            except OSError as e:
+                # never swallow the read failure: report it and hand the
+                # packfile back so the next tick retries instead of the
+                # stripe silently vanishing from this run
+                self._log(f"packfile {bytes(pid).hex()[:8]} read failed:"
+                          f" {e}; queued for retry")
+                leftover.append((pid, path, size))
                 continue
             # GF(2^8) matmul (device or numpy oracle): off the event loop
             containers = await loop.run_in_executor(
                 None, rs_stripe.split_packfile, data, k, m, self.backend)
             for i in missing:
-                self._save_shard_challenge_table(pid, i, containers[i])
+                await self._blocking(
+                    self._save_shard_challenge_table, pid, i, containers[i])
+            pairs = list(zip(missing, conns))
+            tasks = [
+                sched.submit(peer_id, len(containers[i]),
+                             self._shard_job(transport, peer_id, pid, i,
+                                             containers[i]),
+                             label=f"shard:{bytes(pid).hex()[:8]}:{i}")
+                for i, (transport, peer_id, _free) in pairs]
             all_acked = True
-            for i, (transport, peer_id, _free) in zip(missing, conns):
-                sid = rs_stripe.shard_id(pid, i)
-                try:
-                    await transport.send_data(
-                        containers[i], wire.FileInfoKind.SHARD, sid)
-                except P2PError:
-                    await self._drop_transport(orch, peer_id)
+            for ((i, (_t, peer_id, _f)), r) in zip(
+                    pairs, await sched.gather(tasks)):
+                if r.ok:
+                    holders[i] = bytes(peer_id)
+                else:
+                    # this shard's failure stays its own: the siblings
+                    # already completed to THEIR peers
                     all_acked = False
-                    continue  # remaining shards still go to THEIR peers
-                self.store.add_peer_transmitted(peer_id, len(containers[i]))
-                self.store.record_placement(pid, peer_id, len(containers[i]),
-                                            shard_index=i)
-                holders[i] = bytes(peer_id)
+                    if isinstance(r.error, P2PError):
+                        await self._drop_transport(orch, peer_id)
             if all_acked and len(holders) == n:
-                self._finish_stripe(orch, pid, path, size)
+                await self._finish_stripe(orch, pid, path, size)
                 placed_bytes += size
                 if self.messenger is not None:
                     self.messenger.erasure(bytes(pid).hex(), "placed",
@@ -479,12 +566,23 @@ class Engine:
                 leftover.append((pid, path, size))
         return leftover, placed_bytes
 
-    def _finish_stripe(self, orch: Orchestrator, pid: bytes, path: Path,
-                       size: int) -> None:
+    def _shard_job(self, transport, peer_id: bytes, pid: bytes, index: int,
+                   container: bytes):
+        """One scheduled shard transfer + its post-ack bookkeeping."""
+        async def job() -> None:
+            await transport.send_data(container, wire.FileInfoKind.SHARD,
+                                      rs_stripe.shard_id(pid, index))
+            self.store.add_peer_transmitted(peer_id, len(container))
+            self.store.record_placement(pid, peer_id, len(container),
+                                        shard_index=index)
+        return job
+
+    async def _finish_stripe(self, orch: Orchestrator, pid: bytes,
+                             path: Path, size: int) -> None:
         """Local-delete + accounting once every shard of ``pid`` is acked
         (the striped analogue of the post-ack unlink in the legacy path)."""
         try:
-            path.unlink()
+            await self._blocking(path.unlink)
         except OSError:
             pass
         orch.bytes_sent += size
@@ -555,9 +653,14 @@ class Engine:
             # (the peer's writer refuses overwrites, which would livelock).
             # Mirrors send.rs re-checking highest_sent_index per file.
             watermark = self.store.get_highest_sent_index()
-            files = sorted((p for p in self._index_dir().iterdir()
-                            if p.name.isdigit() and int(p.name) > watermark),
-                           key=lambda p: int(p.name))
+
+            def scan(wm=watermark):
+                return sorted(
+                    (p for p in self._index_dir().iterdir()
+                     if p.name.isdigit() and int(p.name) > wm),
+                    key=lambda p: int(p.name))
+
+            files = await self._blocking(scan)
             if not files:
                 return
             transport, peer_id, _free = await self._get_peer_connection(
@@ -568,14 +671,17 @@ class Engine:
             peer_wait.reset()
             request_timer.reset()
             try:
+                # index files stay strictly sequential on one peer: the
+                # watermark is a prefix property, so out-of-order acks
+                # would let a crash skip files on resume
                 for f in files:
                     num = int(f.name)
+                    data = await self._blocking(f.read_bytes)
                     await transport.send_data(
-                        f.read_bytes(), wire.FileInfoKind.INDEX,
+                        data, wire.FileInfoKind.INDEX,
                         num.to_bytes(8, "little"))
                     self.store.set_highest_sent_index(num)
-                    self.store.add_peer_transmitted(peer_id,
-                                                    f.stat().st_size)
+                    self.store.add_peer_transmitted(peer_id, len(data))
                 return
             except P2PError:
                 await self._drop_transport(orch, peer_id)
@@ -944,14 +1050,18 @@ class Engine:
         unrebuildable = []
         loop = asyncio.get_running_loop()
         orch = Orchestrator()  # transport bookkeeping for fresh placements
+        sched = TransferScheduler(messenger=self.messenger)
+
+        def read_staged(d: Path) -> list:
+            if not d.is_dir():
+                return []
+            return [f.read_bytes() for f in sorted(d.iterdir())
+                    if f.is_file()]
+
         try:
             for pidb, lost_map in stripe_lost.items():
                 shard_dir = staging / "shard" / pidb.hex()
-                blobs = []
-                if shard_dir.is_dir():
-                    blobs = [f.read_bytes()
-                             for f in sorted(shard_dir.iterdir())
-                             if f.is_file()]
+                blobs = await self._blocking(read_staged, shard_dir)
                 missing = sorted(lost_map)
                 try:
                     new_shards = await loop.run_in_executor(
@@ -971,26 +1081,27 @@ class Engine:
                 conns = await self._get_stripe_connections(
                     orch, len(missing), holders | lost | self._avoid_peers,
                     max(len(c) for c in new_shards.values()))
-                placed_here = 0
-                for idx, (transport, peer_id, _free) in zip(missing, conns):
+                pairs = list(zip(missing, conns))
+                tasks = []
+                for idx, (transport, peer_id, _free) in pairs:
                     container = new_shards[idx]
-                    self._save_shard_challenge_table(pidb, idx, container)
-                    try:
-                        await transport.send_data(
-                            container, wire.FileInfoKind.SHARD,
-                            rs_stripe.shard_id(pidb, idx))
-                    except P2PError:
+                    await self._blocking(self._save_shard_challenge_table,
+                                         pidb, idx, container)
+                    tasks.append(sched.submit(
+                        peer_id, len(container),
+                        self._repair_shard_job(transport, peer_id, pidb,
+                                               idx, container,
+                                               lost_map[idx][0]),
+                        label=f"repair:{pidb.hex()[:8]}:{idx}"))
+                placed_here = 0
+                for ((idx, (_t, peer_id, _f)), r) in zip(
+                        pairs, await sched.gather(tasks)):
+                    if r.ok:
+                        rebuilt += 1
+                        placed_here += 1
+                        placed_bytes += len(new_shards[idx])
+                    elif isinstance(r.error, P2PError):
                         await self._drop_transport(orch, peer_id)
-                        continue
-                    self.store.add_peer_transmitted(peer_id, len(container))
-                    self.store.record_placement(
-                        pidb, peer_id, len(container), shard_index=idx)
-                    # the replacement is acked: the dead row can go now
-                    # instead of waiting for the end-of-round retirement
-                    self.store.retire_placement(pidb, lost_map[idx][0])
-                    rebuilt += 1
-                    placed_here += 1
-                    placed_bytes += len(container)
                 if placed_here < len(missing):
                     self._log(f"stripe {pidb.hex()[:8]}: re-homed only "
                               f"{placed_here}/{len(missing)} shard(s); "
@@ -1002,8 +1113,23 @@ class Engine:
         finally:
             for peer_id in list(orch.active_transports):
                 await self._drop_transport(orch, peer_id)
-            shutil.rmtree(staging, ignore_errors=True)
+            await self._blocking(
+                lambda: shutil.rmtree(staging, ignore_errors=True))
         return rebuilt, placed_bytes, unrebuildable
+
+    def _repair_shard_job(self, transport, peer_id: bytes, pidb: bytes,
+                          idx: int, container: bytes, dead_peer: bytes):
+        """One scheduled replacement-shard transfer; on ack the dead row
+        retires immediately instead of waiting for the end-of-round
+        retirement."""
+        async def job() -> None:
+            await transport.send_data(container, wire.FileInfoKind.SHARD,
+                                      rs_stripe.shard_id(pidb, idx))
+            self.store.add_peer_transmitted(peer_id, len(container))
+            self.store.record_placement(pidb, peer_id, len(container),
+                                        shard_index=idx)
+            self.store.retire_placement(pidb, dead_peer)
+        return job
 
     async def _repack_and_send(self, bytes_lost: int) -> int:
         """Re-pack forgotten blobs from source and send to fresh peers.
@@ -1023,14 +1149,18 @@ class Engine:
         def pack_thread() -> None:
             writer = PackfileWriter(
                 self.keys, self._pack_dir(),
-                on_packfile=self._on_packfile_threadsafe(loop))
+                on_packfile=self._on_packfile_threadsafe(loop),
+                seal_workers=defaults.PACK_SEAL_WORKERS)
             packer = DirPacker(self.backend, writer, self.index,
                                progress=self._pack_progress,
                                should_pause=orch.block_if_paused,
                                dedup_batch=(self.device_dedup.classify_insert
                                             if self.device_dedup else None))
-            with tracing.span("engine.repair_pack"):
-                packer.pack(root)
+            try:
+                with tracing.span("engine.repair_pack"):
+                    packer.pack(root)
+            finally:
+                writer.shutdown()
 
         pack_fut = loop.run_in_executor(None, pack_thread)
         send_task = asyncio.create_task(self._send_loop(orch, estimate))

@@ -275,24 +275,34 @@ class ReceivedFilesWriter:
         else:
             sub = "pack"
         d = self.dir / sub
-        d.mkdir(parents=True, exist_ok=True)
         path = d / bytes(file_id).hex()
-        if path.exists():
-            # Idempotent re-send: if the sender's ack was lost (crash or
-            # drop between our write and their receive) it will retry the
-            # identical file on a fresh session.  Same id + same bytes =>
-            # ack without re-counting quota; anything else is still the
-            # collision refusal (received_files_writer.rs:54-56).  XOR
-            # obfuscation is deterministic, so comparing stored bytes
-            # against the re-obfuscated payload is exact.
-            if path.read_bytes() == obfuscate(data, self.key):
-                return
-            raise P2PError(f"refusing to overwrite {path.name}"
-                           " with different bytes")
-        if len(data) > self._quota_left():
-            raise P2PError("peer exceeded negotiated storage quota")
-        path.write_bytes(obfuscate(data, self.key))
-        self.store.add_peer_received(self.peer_id, len(data))
+        loop = asyncio.get_running_loop()
+
+        def persist() -> bool:
+            """Blocking disk work off the event loop (the prover may be
+            mid-backup itself: a slow disk here must not stall its own
+            transfer plane).  Returns True if the file was new."""
+            d.mkdir(parents=True, exist_ok=True)
+            if path.exists():
+                # Idempotent re-send: if the sender's ack was lost (crash
+                # or drop between our write and their receive) it will
+                # retry the identical file on a fresh session.  Same id +
+                # same bytes => ack without re-counting quota; anything
+                # else is still the collision refusal
+                # (received_files_writer.rs:54-56).  XOR obfuscation is
+                # deterministic, so comparing stored bytes against the
+                # re-obfuscated payload is exact.
+                if path.read_bytes() == obfuscate(data, self.key):
+                    return False
+                raise P2PError(f"refusing to overwrite {path.name}"
+                               " with different bytes")
+            if len(data) > self._quota_left():
+                raise P2PError("peer exceeded negotiated storage quota")
+            path.write_bytes(obfuscate(data, self.key))
+            return True
+
+        if await loop.run_in_executor(None, persist):
+            self.store.add_peer_received(self.peer_id, len(data))
 
     def iter_stored(self):
         """Yield (file_info, file_id, de-obfuscated bytes) of everything this
@@ -332,8 +342,14 @@ class RestoreFilesWriter:
         else:
             d = self.dir / "pack" / bytes(file_id).hex()[:2]
             name = bytes(file_id).hex()
-        d.mkdir(parents=True, exist_ok=True)
-        (d / name).write_bytes(data)
+        def persist() -> None:
+            d.mkdir(parents=True, exist_ok=True)
+            (d / name).write_bytes(data)
+
+        # restore pulls run one Receiver per peer concurrently; the write
+        # happens off the loop so one slow disk flush never stalls the
+        # other peers' frames
+        await asyncio.get_running_loop().run_in_executor(None, persist)
         self.files += 1
 
 

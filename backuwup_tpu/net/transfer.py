@@ -1,0 +1,156 @@
+"""Bounded-concurrency transfer scheduler: the send plane's fan-out seam.
+
+The reference transmits strictly one file at a time (``send.rs`` awaits
+every ack inline); this module lets the engine keep many uploads in
+flight at once — every missing shard of a stripe to its distinct peer,
+and several whole packfiles to several connected peers — while keeping
+the three invariants the serial code had for free (docs/transfer.md):
+
+* **Per-peer ordering.**  One ``asyncio.Lock`` per peer serializes the
+  actual sends to that peer, and submissions park on the lock in FIFO
+  order, so a peer observes the same file sequence the serial loop would
+  have produced.  This is load-bearing: a Transport assigns its signed
+  sequence number synchronously inside ``send_data`` and the receiver
+  rejects any reordering as a "sequence break", so two concurrent
+  ``send_data`` calls on one transport would poison the session.
+* **Bounded in-flight bytes.**  Admission waits until the payload fits
+  under ``max_inflight_bytes`` (and ``max_transfers``); a transfer larger
+  than the whole budget is still admitted when nothing else is in flight
+  so oversize files cannot deadlock the plane.  The cap bounds the RAM
+  the plane holds *in addition to* the Orchestrator's on-disk buffer
+  accounting — payloads are read inside the submitted coroutine, after
+  admission, so queued transfers hold no bytes.
+* **Failure isolation.**  Each transfer's exception is captured in its
+  ``TransferResult``; sibling transfers to other peers run to completion
+  and the caller decides per-peer what to drop — exactly the blast
+  radius a failed peer had under the serial loop.
+
+Telemetry flows through ``messenger.transfer`` per completed transfer
+(in-flight gauges, wait/send stage times) so the UI can watch the plane
+breathe.  The fault plane (utils/faults.py) hooks the Transport layer
+below this module and keeps working unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from .. import defaults
+
+
+@dataclass
+class TransferResult:
+    """Outcome of one scheduled transfer (never raises past the plane)."""
+
+    peer_id: bytes
+    size: int
+    ok: bool
+    error: Optional[BaseException] = None
+    label: str = ""
+    wait_s: float = 0.0  # admission + per-peer ordering queue time
+    send_s: float = 0.0  # time inside the send coroutine
+
+
+class TransferScheduler:
+    """Admission control + per-peer ordering for concurrent uploads.
+
+    ``submit`` returns an ``asyncio.Task`` resolving to a
+    ``TransferResult``; the task never raises (cancellation aside), so a
+    ``gather`` over a batch cannot be torn down by one bad peer.
+    """
+
+    def __init__(self, max_inflight_bytes: Optional[int] = None,
+                 max_transfers: Optional[int] = None, messenger=None):
+        self.max_inflight_bytes = int(
+            defaults.TRANSFER_INFLIGHT_BYTE_CAP
+            if max_inflight_bytes is None else max_inflight_bytes)
+        self.max_transfers = int(
+            defaults.TRANSFER_MAX_INFLIGHT
+            if max_transfers is None else max_transfers)
+        self.messenger = messenger
+        self.inflight_bytes = 0
+        self.inflight_count = 0
+        self.completed = 0
+        self.failed = 0
+        self.bytes_sent = 0
+        self.stage_s = {"wait": 0.0, "send": 0.0}
+        self._cond = asyncio.Condition()
+        self._peer_locks: Dict[bytes, asyncio.Lock] = {}
+
+    # --- admission (the in-flight byte cap) --------------------------------
+
+    async def _admit(self, size: int) -> None:
+        async with self._cond:
+            while self.inflight_count > 0 and (
+                    self.inflight_count >= self.max_transfers
+                    or self.inflight_bytes + size > self.max_inflight_bytes):
+                await self._cond.wait()
+            self.inflight_count += 1
+            self.inflight_bytes += size
+
+    async def _release(self, size: int) -> None:
+        async with self._cond:
+            self.inflight_count -= 1
+            self.inflight_bytes -= size
+            self._cond.notify_all()
+
+    # --- submission --------------------------------------------------------
+
+    def submit(self, peer_id: bytes, size: int,
+               send: Callable[[], Awaitable[None]],
+               label: str = "") -> "asyncio.Task[TransferResult]":
+        """Schedule ``send()`` (which reads + transmits + does post-ack
+        bookkeeping) as one bounded, peer-ordered transfer."""
+        return asyncio.ensure_future(
+            self._run(bytes(peer_id), int(size), send, label))
+
+    async def _run(self, peer_id: bytes, size: int,
+                   send: Callable[[], Awaitable[None]],
+                   label: str) -> TransferResult:
+        t0 = time.monotonic()
+        # Per-peer lock first: asyncio.Lock wakes waiters FIFO and tasks
+        # run synchronously up to their first await, so same-peer
+        # transfers send in submission order.  Admission happens inside
+        # the lock so parked transfers hold no byte budget.
+        lock = self._peer_locks.setdefault(peer_id, asyncio.Lock())
+        async with lock:
+            await self._admit(size)
+            t1 = time.monotonic()
+            try:
+                await send()
+                result = TransferResult(peer_id, size, True, label=label)
+            except (Exception, asyncio.TimeoutError) as e:
+                result = TransferResult(peer_id, size, False, error=e,
+                                        label=label)
+            finally:
+                t2 = time.monotonic()
+                await self._release(size)
+        result.wait_s = t1 - t0
+        result.send_s = t2 - t1
+        self.stage_s["wait"] += result.wait_s
+        self.stage_s["send"] += result.send_s
+        if result.ok:
+            self.completed += 1
+            self.bytes_sent += size
+        else:
+            self.failed += 1
+        if self.messenger is not None:
+            self.messenger.transfer(
+                peer_id.hex()[:16], "sent" if result.ok else "failed",
+                size=size, inflight=self.inflight_count,
+                inflight_bytes=self.inflight_bytes,
+                wait_ms=result.wait_s * 1000.0,
+                send_ms=result.send_s * 1000.0, label=label)
+        return result
+
+    @staticmethod
+    async def gather(tasks: List["asyncio.Task[TransferResult]"]
+                     ) -> List[TransferResult]:
+        """Await a batch; results arrive in submission order and no
+        exception escapes (each task resolves to a TransferResult)."""
+        if not tasks:
+            return []
+        return list(await asyncio.gather(*tasks))

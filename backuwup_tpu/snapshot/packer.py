@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, List, Optional
@@ -44,6 +45,10 @@ class PackStats:
     chunks_deduped: int = 0
     bytes_deduped: int = 0
     dedup_divergences: int = 0
+    # wall seconds inside the chunk+hash backend calls — with the
+    # pipelined seal (packfile.py seal_workers) this stage overlaps the
+    # seal/write/upload stages instead of summing with them
+    chunk_hash_s: float = 0.0
 
 
 class DirPacker:
@@ -134,8 +139,10 @@ class DirPacker:
         def flush_batch():
             if not batch_idx:
                 return
+            t0 = time.monotonic()
             with tracing.span("packer.manifest_many"):
                 manifests = self.backend.manifest_many(batch_data)
+            self.stats.chunk_hash_s += time.monotonic() - t0
             hints = iter(())
             if self.dedup_batch is not None:
                 # blobs classified host-side since the last batch (streamed
@@ -219,6 +226,7 @@ class DirPacker:
                 mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
             except (OSError, ValueError):
                 mm = None  # empty/truncated/unmappable: plain reads
+            t0 = time.monotonic()
             if mm is None:
                 self.backend.manifest_stream(
                     f.read, segment_bytes=self.batch_bytes, emit=emit)
@@ -244,6 +252,7 @@ class DirPacker:
                         # window slices; closing would mask the real
                         # error — let GC drop the mapping instead
                         pass
+        self.stats.chunk_hash_s += time.monotonic() - t0
         self.stats.files += 1
         self.progress(file=str(path), bytes=st.st_size)
         return self._tree_with_split(

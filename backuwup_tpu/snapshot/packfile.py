@@ -28,6 +28,10 @@ raises ``DirtyPackfileError`` if data would be lost.
 from __future__ import annotations
 
 import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Callable, Dict, List, Optional
@@ -102,6 +106,21 @@ class PackfileWriter:
 
     ``on_packfile(packfile_id, path, blob_hashes, size)`` fires after each
     file lands on disk — the seam the send pipeline and blob index hang off.
+
+    With ``seal_workers=0`` (the default) every blob is compressed +
+    encrypted inline in ``add_blob`` and packfiles are written
+    synchronously at the thresholds — the original behavior, byte for
+    byte.  With ``seal_workers > 0`` the seal work (zstd + AES-GCM, both
+    release the GIL) runs on a small thread pool and packfile assembly +
+    disk writes run on a single ordered writer thread, double-buffered:
+    at most ``defaults.PACK_SEAL_QUEUE_PACKFILES`` batches may be in
+    flight before ``add_blob`` blocks, so chunk+hash, seal, and upload
+    overlap instead of summing (docs/transfer.md).  The hard size cap is
+    then enforced on the writer thread against actual ciphertext sizes
+    (a batch splits into several packfiles if needed); worker errors
+    surface on the next ``add_blob``/``flush``.  ``on_packfile`` fires on
+    the writer thread — same off-loop contract as the packer-thread
+    callback in synchronous mode.
     """
 
     # encoded header entry: hash(32) + kind(4) + compression(4) + length(8)
@@ -110,7 +129,8 @@ class PackfileWriter:
     _FILE_OVERHEAD = 8 + 16 + 8
 
     def __init__(self, keys: KeyManager, out_dir: Path,
-                 on_packfile: Optional[Callable] = None):
+                 on_packfile: Optional[Callable] = None,
+                 seal_workers: int = 0):
         self.keys = keys
         self.out_dir = Path(out_dir)
         self.on_packfile = on_packfile
@@ -119,13 +139,50 @@ class PackfileWriter:
         self._pending_ct = 0
         self._header_key = keys.derive_backup_key(HEADER_KEY_INFO)
         self.bytes_written = 0
+        self.seal_workers = max(0, int(seal_workers or 0))
+        self._seal_pool: Optional[ThreadPoolExecutor] = None
+        self._write_pool: Optional[ThreadPoolExecutor] = None
+        self._batch: List = []  # futures of _Pending, submission order
+        self._writes: deque = deque()  # in-flight assemble+write futures
+        self._stats_lock = threading.Lock()
+        self.stage_seconds = {"seal": 0.0, "write": 0.0, "stall": 0.0}
+        if self.seal_workers:
+            self._seal_pool = ThreadPoolExecutor(
+                max_workers=self.seal_workers,
+                thread_name_prefix="pack-seal")
+            # exactly one writer thread: packfile writes stay ordered
+            self._write_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="pack-write")
 
     def _file_size(self, n_blobs: int, ct_bytes: int) -> int:
         return self._FILE_OVERHEAD + n_blobs * self._HEADER_ENTRY + ct_bytes
 
     @property
+    def _cap(self) -> int:
+        # the binding cap is the smaller of the format cap (16 MiB,
+        # packfile/mod.rs:27) and what one signed transport message can
+        # carry (defaults.PACKFILE_WIRE_MAX) — a packfile that cannot be
+        # sent would strand the backup
+        return min(defaults.PACKFILE_MAX_SIZE, defaults.PACKFILE_WIRE_MAX)
+
+    @property
     def pending_blobs(self) -> int:
-        return len(self._pending)
+        return len(self._pending) + len(self._batch)
+
+    def _seal_blob(self, blob_hash: bytes, kind, data: bytes) -> _Pending:
+        """compress + encrypt one blob (GIL-releasing hot path)."""
+        t0 = time.monotonic()
+        comp_kind, comp = _compress(data)
+        key = self.keys.derive_backup_key(blob_hash)
+        nonce = os.urandom(NONCE_LEN)
+        ct = AESGCM(key).encrypt(nonce, comp, None)
+        record = nonce + ct
+        header = PackfileHeaderBlob(
+            hash=blob_hash, kind=kind, compression=comp_kind,
+            length=len(record), offset=0)  # offset assigned at write time
+        with self._stats_lock:
+            self.stage_seconds["seal"] += time.monotonic() - t0
+        return _Pending(header, record, len(data))
 
     def add_blob(self, blob: Blob) -> None:
         """Encrypt + queue one blob; trigger a packfile write at thresholds.
@@ -133,16 +190,12 @@ class PackfileWriter:
         Dedup is the caller's job (the blob index) — this layer packs what
         it is given, mirroring pack.rs:31-55's split of responsibilities.
         """
-        comp_kind, comp = _compress(blob.data)
-        key = self.keys.derive_backup_key(blob.hash)
-        nonce = os.urandom(NONCE_LEN)
-        ct = AESGCM(key).encrypt(nonce, comp, None)
-        record = nonce + ct
-        # the binding cap is the smaller of the format cap (16 MiB,
-        # packfile/mod.rs:27) and what one signed transport message can
-        # carry (defaults.PACKFILE_WIRE_MAX) — a packfile that cannot be
-        # sent would strand the backup
-        cap = min(defaults.PACKFILE_MAX_SIZE, defaults.PACKFILE_WIRE_MAX)
+        if self.seal_workers:
+            self._add_blob_pipelined(blob)
+            return
+        p = self._seal_blob(blob.hash, blob.kind, blob.data)
+        record = p.record
+        cap = self._cap
         if self._file_size(1, len(record)) > cap:
             raise PackfileError("single blob exceeds packfile max size")
         # hard cap is enforced *before* anything hits disk: flush the current
@@ -152,30 +205,93 @@ class PackfileWriter:
                                 self._pending_ct + len(record))
                 > cap):
             self._write_packfile()
-        header = PackfileHeaderBlob(
-            hash=blob.hash, kind=blob.kind, compression=comp_kind,
-            length=len(record), offset=0)  # offset assigned at write time
-        self._pending.append(_Pending(header, record, len(blob.data)))
+        self._pending.append(p)
         self._pending_plain += len(blob.data)
         self._pending_ct += len(record)
         if (self._pending_plain >= defaults.PACKFILE_TARGET_SIZE
                 or len(self._pending) >= defaults.PACKFILE_MAX_BLOBS):
             self._write_packfile()
 
+    # --- pipelined seal path (seal_workers > 0) ----------------------------
+
+    def _add_blob_pipelined(self, blob: Blob) -> None:
+        self._batch.append(self._seal_pool.submit(
+            self._seal_blob, blob.hash, blob.kind, blob.data))
+        self._pending_plain += len(blob.data)
+        if (self._pending_plain >= defaults.PACKFILE_TARGET_SIZE
+                or len(self._batch) >= defaults.PACKFILE_MAX_BLOBS):
+            self._submit_batch()
+
+    def _submit_batch(self) -> None:
+        batch, self._batch = self._batch, []
+        self._pending_plain = 0
+        # double buffering: at most PACK_SEAL_QUEUE_PACKFILES batches may
+        # be sealing/writing; beyond that the packer thread stalls here
+        # (and surfaces any earlier writer-thread error)
+        t0 = time.monotonic()
+        while len(self._writes) >= max(1, defaults.PACK_SEAL_QUEUE_PACKFILES):
+            self._writes.popleft().result()
+        with self._stats_lock:
+            self.stage_seconds["stall"] += time.monotonic() - t0
+        self._writes.append(self._write_pool.submit(
+            self._assemble_batch, batch))
+
+    def _assemble_batch(self, batch: List) -> None:
+        """Writer thread: wait for the batch's seals, split on the hard
+        cap against actual ciphertext sizes, and write each group."""
+        pendings = [f.result() for f in batch]
+        cap = self._cap
+        group: List[_Pending] = []
+        ct = 0
+        for p in pendings:
+            if self._file_size(1, len(p.record)) > cap:
+                raise PackfileError("single blob exceeds packfile max size")
+            if group and (self._file_size(len(group) + 1,
+                                          ct + len(p.record)) > cap):
+                self._write_group(group)
+                group, ct = [], 0
+            group.append(p)
+            ct += len(p.record)
+        if group:
+            self._write_group(group)
+
     def flush(self) -> None:
+        if self.seal_workers:
+            if self._batch:
+                self._submit_batch()
+            while self._writes:
+                self._writes.popleft().result()
+            return
         if self._pending:
             self._write_packfile()
 
     def close(self) -> None:
-        if self._pending:
+        if self._pending or self._batch:
             raise DirtyPackfileError(
-                f"{len(self._pending)} unflushed blobs — call flush()")
+                f"{len(self._pending) + len(self._batch)} unflushed blobs"
+                " — call flush()")
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        """Tear down the seal/writer pools without the dirty check (for
+        ``finally`` blocks where flush may already have raised)."""
+        if self._seal_pool is not None:
+            self._seal_pool.shutdown(wait=True)
+        if self._write_pool is not None:
+            self._write_pool.shutdown(wait=True)
 
     def _write_packfile(self) -> None:
+        self._write_group(self._pending)
+        self._pending = []
+        self._pending_plain = 0
+        self._pending_ct = 0
+
+    def _write_group(self, pendings: List[_Pending]) -> None:
+        t0 = time.monotonic()
         packfile_id = os.urandom(PACKFILE_ID_LEN)
         offset = 0
         headers = []
-        for p in self._pending:
+        for p in pendings:
             headers.append(PackfileHeaderBlob(
                 hash=p.header.hash, kind=p.header.kind,
                 compression=p.header.compression, length=p.header.length,
@@ -192,18 +308,15 @@ class PackfileWriter:
         with open(tmp, "wb") as f:
             f.write(len(header_ct).to_bytes(8, "little"))
             f.write(header_ct)
-            for p in self._pending:
+            for p in pendings:
                 f.write(p.record)
         os.replace(tmp, path)
         size = path.stat().st_size
-        self.bytes_written += size
+        with self._stats_lock:
+            self.bytes_written += size
+            self.stage_seconds["write"] += time.monotonic() - t0
         hashes = [h.hash for h in headers]
-        self._pending = []
-        self._pending_plain = 0
-        self._pending_ct = 0
-        assert size <= min(defaults.PACKFILE_MAX_SIZE,
-                           defaults.PACKFILE_WIRE_MAX), \
-            "cap enforced in add_blob"
+        assert size <= self._cap, "cap enforced before write"
         if self.on_packfile is not None:
             self.on_packfile(packfile_id, path, hashes, size)
 

@@ -13,7 +13,7 @@ import json
 import time
 from collections import deque
 from dataclasses import asdict, dataclass, field
-from typing import Callable, List
+from typing import Callable, List, Optional
 
 from .. import defaults
 
@@ -109,6 +109,26 @@ class Messenger:
                                            "outcome": outcome,
                                            "shards": shards,
                                            "rebuilt": rebuilt}))
+
+    def transfer(self, peer: str, outcome: str, size: int = 0,
+                 inflight: int = 0, inflight_bytes: int = 0,
+                 wait_ms: float = 0.0, send_ms: float = 0.0,
+                 label: str = "", stages: Optional[dict] = None) -> None:
+        """Transfer-plane telemetry frame (net/transfer.py).
+
+        ``outcome``: ``sent`` | ``failed`` per completed transfer, or
+        ``summary`` for the end-of-run per-stage roll-up (``stages`` maps
+        stage name -> seconds: seal/write/wait/send).  ``inflight`` /
+        ``inflight_bytes`` are the plane's gauges at emission time.
+        """
+        payload = {"peer": peer, "outcome": outcome, "size": size,
+                   "inflight": inflight, "inflight_bytes": inflight_bytes,
+                   "wait_ms": round(wait_ms, 3), "send_ms": round(send_ms, 3),
+                   "label": label}
+        if stages:
+            payload["stages"] = {k: round(float(v), 4)
+                                 for k, v in stages.items()}
+        self._emit(StatusEvent("transfer", payload))
 
     def error(self, text: str) -> None:
         self._emit(StatusEvent("error", {"text": text}))

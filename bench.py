@@ -194,7 +194,7 @@ def main() -> None:
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
         configs = bench_configs.run_all(pipeline, params, cpu_mibs, log)
 
-    print(json.dumps({
+    record = {
         "metric": "dedup pipeline chunk+hash throughput (device-resident)",
         "value": round(tpu_mibs, 2),
         "unit": "MiB/s",
@@ -203,6 +203,14 @@ def main() -> None:
         "corpus_gib": round(done_segments * seg_mib / 1024, 2),
         "wall_s": round(tpu_s, 2),
         "configs": configs,
+    }
+    # config #8 measures serial-vs-concurrent in one run; surface the
+    # ratio at top level so BENCH_r*.json diffs track it directly
+    transfer = configs.get("8_transfer", {})
+    if "speedup" in transfer:
+        record["transfer_speedup"] = transfer["speedup"]
+    print(json.dumps({
+        **record,
         "note": "corpus synthesized on-device (host<->device relay tunnel "
                 "~6 MiB/s would measure the tunnel, not the kernels); "
                 "parity vs CPU oracle gated per config",

@@ -17,8 +17,13 @@ identical dedup output is meaningless):
   #6  end-to-end backup    — DirPacker over a real on-disk tree on the
       host-side engine (packer/packfile/index overheads made visible)
 
+  #7  erasure coding      — RS shard encode/decode throughput
+  #8  transfer plane      — serial-vs-concurrent end-to-end backup over
+      loopback p2p with N latency-injected peers (ratio, not sustained)
+
 Environment knobs: BENCH_C2_FILES, BENCH_C3_MIB, BENCH_C4_GIB,
-BENCH_C5_HASHES, BENCH_C6_MIB.
+BENCH_C5_HASHES, BENCH_C6_MIB, BENCH_C7_SHARD_KIB, BENCH_C7_STRIPES,
+BENCH_C8_MIB, BENCH_C8_PEERS, BENCH_C8_LATENCY_S.
 """
 
 from __future__ import annotations
@@ -578,6 +583,142 @@ def config7_erasure(log: Callable) -> Dict:
             "wall_s": round(dt, 2)}
 
 
+def config8_transfer(log: Callable) -> Dict:
+    """Serial-vs-concurrent transfer plane over loopback p2p — config #8.
+
+    Spins up a CoordinationServer, one source client, and N holder
+    clients in-process, then runs the SAME end-to-end backup twice with
+    per-send latency injected through the fault plane (a loopback socket
+    is too fast for transfer order to matter otherwise):
+
+      serial     — TRANSFER_MAX_INFLIGHT=1, TRANSFER_MAX_PEERS=1,
+                   PACK_SEAL_WORKERS=0: one transfer in flight at a
+                   time and a synchronous seal, the pre-transfer-plane
+                   shape
+      concurrent — the shipped defaults: all shards of a stripe in
+                   flight to distinct peers, pipelined seal
+
+    Both numbers land in one record so BENCH_r*.json tracks the ratio.
+    This is a ratio measurement (one pass each), not a sustained-window
+    throughput config.
+    """
+    import asyncio
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from backuwup_tpu import defaults
+    from backuwup_tpu.app import ClientApp
+    from backuwup_tpu.net.server import CoordinationServer
+    from backuwup_tpu.ops.backend import CpuBackend, NativeBackend
+    from backuwup_tpu.utils import faults
+
+    total_mib = int(os.environ.get("BENCH_C8_MIB", "4"))
+    n_peers = int(os.environ.get("BENCH_C8_PEERS", "6"))
+    latency_s = float(os.environ.get("BENCH_C8_LATENCY_S", "0.04"))
+
+    saved = {k: getattr(defaults, k) for k in (
+        "PACKFILE_TARGET_SIZE", "TRANSFER_MAX_INFLIGHT",
+        "TRANSFER_MAX_PEERS", "PACK_SEAL_WORKERS")}
+    tmp = Path(tempfile.mkdtemp(prefix="bkw_bench_c8_"))
+    rng = np.random.default_rng(81)
+    src = tmp / "src"
+    src.mkdir()
+    written = 0
+    i = 0
+    while written < (total_mib << 20):
+        sub = src / f"d{i % 8}"
+        sub.mkdir(exist_ok=True)
+        n = int(rng.integers(64 << 10, 512 << 10))
+        (sub / f"f{i}").write_bytes(rng.bytes(n))
+        written += n
+        i += 1
+
+    async def one_backup(tag: str) -> float:
+        server = CoordinationServer(db_path=str(tmp / f"server_{tag}.db"))
+        port = await server.start()
+
+        def make_app(name):
+            # native chunk+hash where available: the measurement is the
+            # transfer plane, not the python oracle chunker
+            params = CDCParams.from_desired(16 << 10)
+            try:
+                backend = NativeBackend(params)
+            except Exception:
+                backend = CpuBackend(params)
+            app = ClientApp(config_dir=tmp / tag / name / "cfg",
+                            data_dir=tmp / tag / name / "data",
+                            server_addr=f"127.0.0.1:{port}",
+                            backend=backend)
+            app.store.set_backup_path(str(src))
+            return app
+
+        a = make_app("a")
+        holders = [make_app(f"p{j}") for j in range(n_peers)]
+        apps = [a] + holders
+        try:
+            for app in apps:
+                await app.start()
+                app._audit_task.cancel()
+            a.engine.auto_repair = False
+            amt = 8 * (written + (64 << 20)) // max(1, n_peers)
+            for peer in holders:
+                a.store.add_peer_negotiated(peer.client_id, amt)
+                peer.store.add_peer_negotiated(a.client_id, amt)
+                server.db.save_storage_negotiated(
+                    bytes(a.client_id), bytes(peer.client_id), amt)
+            t0 = time.time()
+            snapshot = await asyncio.wait_for(a.backup(), 600)
+            if not snapshot:
+                raise RuntimeError(f"config #8 {tag}: backup returned none")
+            return time.time() - t0
+        finally:
+            for app in apps:
+                try:
+                    await app.stop()
+                except Exception:
+                    pass
+            await server.stop()
+
+    async def both() -> Dict:
+        # always-fire latency on every FILE send: makes the run
+        # transfer-bound so overlap (or its absence) dominates the wall
+        faults.install(faults.FaultPlane(seed=8, latency=1.0,
+                                         latency_s=latency_s))
+        try:
+            defaults.PACKFILE_TARGET_SIZE = 128 * 1024
+            defaults.TRANSFER_MAX_INFLIGHT = 1
+            defaults.TRANSFER_MAX_PEERS = 1
+            defaults.PACK_SEAL_WORKERS = 0
+            serial_wall = await one_backup("serial")
+            defaults.TRANSFER_MAX_INFLIGHT = saved["TRANSFER_MAX_INFLIGHT"]
+            defaults.TRANSFER_MAX_PEERS = saved["TRANSFER_MAX_PEERS"]
+            defaults.PACK_SEAL_WORKERS = saved["PACK_SEAL_WORKERS"]
+            concurrent_wall = await one_backup("concurrent")
+            return {"serial": serial_wall, "concurrent": concurrent_wall}
+        finally:
+            faults.uninstall()
+
+    try:
+        walls = asyncio.run(both())
+        data_mib = written / (1 << 20)
+        serial = data_mib / walls["serial"]
+        concurrent = data_mib / walls["concurrent"]
+        speedup = walls["serial"] / walls["concurrent"]
+        log(f"config#8 transfer: {data_mib:.0f} MiB to {n_peers} peers "
+            f"(+{latency_s * 1000:.0f}ms/send): serial {serial:.2f} MiB/s, "
+            f"concurrent {concurrent:.2f} MiB/s = {speedup:.2f}x")
+        return {"mib_s": round(concurrent, 2),
+                "serial_mib_s": round(serial, 2),
+                "speedup": round(speedup, 2), "peers": n_peers,
+                "latency_ms": round(latency_s * 1000, 1),
+                "wall_s": round(walls["serial"] + walls["concurrent"], 2)}
+    finally:
+        for k, v in saved.items():
+            setattr(defaults, k, v)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -589,7 +730,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("4_large_stream_64k", lambda: config4_large_stream(log)),
             ("5_cross_peer_dedup", lambda: config5_cross_peer(log)),
             ("6_end_to_end", lambda: config6_end_to_end(log)),
-            ("7_erasure", lambda: config7_erasure(log))):
+            ("7_erasure", lambda: config7_erasure(log)),
+            ("8_transfer", lambda: config8_transfer(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

@@ -28,6 +28,17 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "erasure bench recapture FAILED (see $ers) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated transfer recapture: config #8 alone (host-only
+        # loopback p2p, serial-vs-concurrent ratio) — the overlap number
+        # survives even when the device suite above timed out partway
+        trf="$BENCH_OUT_DIR/BENCH_transfer_${stamp}.json"
+        if timeout "${BENCH_TRANSFER_TIMEOUT_S:-600}" \
+                env BENCH_ONLY_CONFIG=8_transfer BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$trf" 2>>/tmp/tpu_watch.log; then
+            echo "transfer bench recaptured to $trf at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "transfer bench recapture FAILED (see $trf) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

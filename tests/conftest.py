@@ -55,6 +55,9 @@ def pytest_configure(config):
         "markers", "accel: needs a real accelerator backend; skipped"
         " cleanly when jax runs on the host platform (tier-1 pins"
         " JAX_PLATFORMS=cpu)")
+    config.addinivalue_line(
+        "markers", "concurrency: deterministic transfer-plane overlap"
+        " tests (fault-plane latency/death injection); tier-1 safe")
 
 
 def pytest_collection_modifyitems(config, items):
