@@ -31,12 +31,17 @@ class ClientApp:
                  messenger: Optional[Messenger] = None,
                  dedup_mesh=None,
                  root_secret: Optional[bytes] = None,
-                 tls: Optional[bool] = None):
+                 tls: Optional[bool] = None,
+                 status_port: Optional[int] = None):
         """``root_secret`` injects a recovered identity (the
         restore-from-phrase flow, ``identity.rs:46-69``): the secret is
         persisted and all keys re-derive deterministically, so a disaster
         recovery proceeds as this identity.  Raises if the store already
-        holds a *different* identity."""
+        holds a *different* identity.
+
+        ``status_port`` (or ``BKW_STATUS_PORT``) opts the client into a
+        loopback /metrics + /healthz listener; port 0 picks an ephemeral
+        port, exposed as :attr:`status_port` after :meth:`start`."""
         self.store = Store(config_dir, data_base=data_dir)
         self.messenger = messenger or Messenger()
         secret = self.store.get_root_secret()
@@ -72,6 +77,12 @@ class ClientApp:
                              backend=backend, messenger=self.messenger,
                              dedup_mesh=dedup_mesh)
         self._audit_task: Optional[asyncio.Task] = None
+        if status_port is None:
+            env_port = os.environ.get("BKW_STATUS_PORT", "")
+            status_port = int(env_port) if env_port else None
+        self._status_port_req = status_port
+        self._status_server = None
+        self.status_port: Optional[int] = None
 
     @classmethod
     def from_phrase(cls, phrase: str, **kwargs) -> "ClientApp":
@@ -96,9 +107,23 @@ class ClientApp:
         await asyncio.wait_for(self.server.ws_connected.wait(), 10)
         self._audit_task = asyncio.create_task(
             self.engine.audit_scheduler())
+        if self._status_port_req is not None:
+            from .obs.expo import StatusServer
+            self._status_server = StatusServer(
+                port=self._status_port_req,
+                health_fn=lambda: {
+                    "client_id": self.client_id.hex(),
+                    "busy": self.engine._exclusive.locked()})
+            self.status_port = await self._status_server.start()
+            self.messenger.log(
+                f"status listener on 127.0.0.1:{self.status_port}")
         self.messenger.log("connected to coordination server")
 
     async def stop(self) -> None:
+        if self._status_server is not None:
+            await self._status_server.stop()
+            self._status_server = None
+            self.status_port = None
         if self._audit_task is not None:
             self._audit_task.cancel()
             try:

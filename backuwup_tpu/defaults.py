@@ -121,6 +121,12 @@ AUDIT_SERVE_MIN_INTERVAL_S = 5.0  # prover-side per-peer rate limit
 AUDIT_SERVER_BLOCK_FAILURES = 2  # distinct failing verifiers to block matches
 AUDIT_REPORT_WINDOW_S = 24 * 3600.0  # server aggregation window
 
+# --- observability plane (obs/, docs/observability.md; no reference
+# equivalent — the reference prints ad-hoc lines) ------------------------------
+OBS_JOURNAL_MAX_BYTES = 4 * MiB  # rotate the JSONL journal past this size
+OBS_JOURNAL_KEEP = 3  # rotated generations retained (<path>.1 .. .keep)
+OBS_PANIC_TAIL_LINES = 200  # journal lines embedded in a panic dump
+
 # --- server-side TTLs (reference server/src/client_auth_manager.rs:17-20) ---
 AUTH_CHALLENGE_TTL_S = 30.0
 SESSION_TTL_S = 24 * 3600.0

@@ -49,6 +49,8 @@ from .erasure import stripe as rs_stripe
 from .net.client import NoBackups, ServerClient, ServerError
 from .net.p2p import P2PError, P2PNode, Receiver, RestoreFilesWriter, Transport
 from .net.transfer import TransferScheduler
+from .obs import metrics as obs_metrics
+from .obs import trace as obs_trace
 from .ops.backend import ChunkerBackend, select_backend
 from .snapshot.blob_index import BlobIndex, ChallengeTable
 from .snapshot.packer import DirPacker
@@ -59,6 +61,34 @@ from .utils import retry, tracing
 
 class EngineError(Exception):
     pass
+
+
+_BACKUP_RUNS = obs_metrics.counter(
+    "bkw_backup_runs_total", "Backup runs by outcome", ("outcome",))
+_RESTORE_RUNS = obs_metrics.counter(
+    "bkw_restore_runs_total", "Restore runs by outcome", ("outcome",))
+_AUDIT_ROUNDS = obs_metrics.counter(
+    "bkw_audit_rounds_total", "Audit rounds run")
+_REPAIR_ROUNDS = obs_metrics.counter(
+    "bkw_repair_rounds_total", "Peer-loss repair rounds run")
+
+
+def _registry_stage_sums() -> Dict[str, float]:
+    """Cumulative per-stage seconds from the registry — the source the
+    end-of-run summary frame is derived from (deltas against a baseline
+    captured at run start, since the registry is process-global)."""
+    reg = obs_metrics.registry()
+    out: Dict[str, float] = {}
+    pack = reg.get("bkw_pack_stage_seconds")
+    if pack is not None:
+        for stage in ("seal", "write", "stall", "chunk_hash"):
+            out[stage] = pack.sum_value(stage=stage)
+    for metric, label in (("bkw_transfer_send_seconds", "send"),
+                          ("bkw_transfer_wait_seconds", "send_wait")):
+        fam = reg.get(metric)
+        if fam is not None:
+            out[label] = fam.sum_value()
+    return out
 
 
 class Orchestrator:
@@ -230,12 +260,20 @@ class Engine:
         if self._exclusive.locked():
             raise EngineError("a backup or restore is already running")
         async with self._exclusive:
-            return await self._run_backup_locked(root)
+            with obs_trace.span("engine.backup"):
+                try:
+                    snapshot = await self._run_backup_locked(root)
+                except BaseException:
+                    _BACKUP_RUNS.inc(outcome="failed")
+                    raise
+            _BACKUP_RUNS.inc(outcome="ok")
+            return snapshot
 
     async def _run_backup_locked(self, root: Optional[Path]) -> bytes:
         root = Path(root or (self.store.get_backup_path() or ""))
         if not root.is_dir():
             raise EngineError(f"backup path {root} is not a directory")
+        stage_base = _registry_stage_sums()
         orch = self.orchestrator = Orchestrator()
         loop = asyncio.get_running_loop()
         # the size estimate walks the whole tree: keep it off the event
@@ -245,6 +283,9 @@ class Engine:
         self._log(f"backup started, estimated {estimate} bytes")
         self._progress(size_estimate=estimate, running=True)
         snapshot_holder: dict = {}
+        # contextvars do not cross run_in_executor: hand the backup's
+        # trace id to the pack thread so its spans journal under it
+        backup_tid = obs_trace.current_trace_id()
 
         def pack_thread() -> None:
             writer = PackfileWriter(
@@ -257,11 +298,11 @@ class Engine:
                                dedup_batch=(self.device_dedup.classify_insert
                                             if self.device_dedup else None))
             try:
-                with tracing.span("engine.pack"), \
+                with obs_trace.bind(backup_tid), \
+                        tracing.span("engine.pack"), \
                         tracing.jax_profiler("backup_pack"):
                     snapshot_holder["hash"] = packer.pack(root)
                 snapshot_holder["stats"] = packer.stats
-                snapshot_holder["seal"] = dict(writer.stage_seconds)
             finally:
                 writer.shutdown()
 
@@ -287,12 +328,13 @@ class Engine:
             "snapshot": snapshot.hex()})
         self._log(f"backup finished: {snapshot.hex()}")
         if self.messenger is not None:
-            stages = dict(snapshot_holder.get("seal") or {})
-            stages["chunk_hash"] = getattr(
-                self.last_pack_stats, "chunk_hash_s", 0.0)
-            if self._transfers is not None:
-                stages["send"] = self._transfers.stage_s["send"]
-                stages["send_wait"] = self._transfers.stage_s["wait"]
+            # the per-stage roll-up is now derived from the metrics
+            # registry (delta vs. the baseline captured at run start),
+            # not hand-carried through the pack thread — one source of
+            # truth shared with GET /metrics
+            now_sums = _registry_stage_sums()
+            stages = {k: now_sums.get(k, 0.0) - stage_base.get(k, 0.0)
+                      for k in now_sums}
             self.messenger.transfer("engine", "summary",
                                     size=orch.bytes_sent, stages=stages)
         if tracing.enabled():
@@ -817,11 +859,13 @@ class Engine:
     async def run_audit_round(self, now: Optional[float] = None) -> Dict:
         """Audit every peer whose ledger says it is due."""
         now = time.time() if now is None else now
+        _AUDIT_ROUNDS.inc()
         results: Dict[bytes, AuditResult] = {}
-        for peer in self.store.audit_due_peers(now):
-            res = await self.audit_peer(peer, now=now)
-            if res is not None:
-                results[bytes(peer)] = res
+        with obs_trace.span("engine.audit_round"):
+            for peer in self.store.audit_due_peers(now):
+                res = await self.audit_peer(peer, now=now)
+                if res is not None:
+                    results[bytes(peer)] = res
         return results
 
     async def audit_scheduler(self, poll_s: float = 30.0) -> None:
@@ -897,7 +941,9 @@ class Engine:
         if self._exclusive.locked():
             raise EngineError("a backup or restore is already running")
         async with self._exclusive:
-            return await self._repair_round_locked(now)
+            _REPAIR_ROUNDS.inc()
+            with obs_trace.span("engine.repair_round"):
+                return await self._repair_round_locked(now)
 
     def _lost_peers(self, now: float) -> set:
         """Peers holding placements that are demoted or dark past deadline."""
@@ -1145,6 +1191,7 @@ class Engine:
         loop = asyncio.get_running_loop()
         orch.set_buffer(self._buffer_bytes())
         estimate = max(bytes_lost, 1)
+        repair_tid = obs_trace.current_trace_id()
 
         def pack_thread() -> None:
             writer = PackfileWriter(
@@ -1157,7 +1204,8 @@ class Engine:
                                dedup_batch=(self.device_dedup.classify_insert
                                             if self.device_dedup else None))
             try:
-                with tracing.span("engine.repair_pack"):
+                with obs_trace.bind(repair_tid), \
+                        tracing.span("engine.repair_pack"):
                     packer.pack(root)
             finally:
                 writer.shutdown()
@@ -1184,7 +1232,14 @@ class Engine:
         if self._exclusive.locked():
             raise EngineError("a backup or restore is already running")
         async with self._exclusive:
-            return await self._run_restore_locked(dest)
+            with obs_trace.span("engine.restore"):
+                try:
+                    out = await self._run_restore_locked(dest)
+                except BaseException:
+                    _RESTORE_RUNS.inc(outcome="failed")
+                    raise
+            _RESTORE_RUNS.inc(outcome="ok")
+            return out
 
     async def _run_restore_locked(self, dest: Optional[Path]) -> Path:
         last = self.store.last_event_time(EVENT_RESTORE_REQUEST)

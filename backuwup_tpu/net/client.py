@@ -14,6 +14,7 @@ uses for testing (requests.rs:246-258).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 from typing import Callable, Optional
@@ -22,6 +23,7 @@ import aiohttp
 
 from .. import wire
 from ..crypto import KeyManager
+from ..obs import trace as obs_trace
 from ..store import Store
 from ..utils import retry
 
@@ -160,8 +162,21 @@ class ServerClient:
     # --- raw RPC -----------------------------------------------------------
 
     async def _post(self, path: str, msg: wire.JsonMessage) -> wire.JsonMessage:
+        with obs_trace.span(f"client{path}"):
+            return await self._post_traced(path, msg)
+
+    async def _post_traced(self, path: str,
+                           msg: wire.JsonMessage) -> wire.JsonMessage:
         http = await self._session()
-        async with http.post(self.base + path, data=msg.to_json()) as resp:
+        payload = msg.to_json()
+        tid = obs_trace.current_trace_id()
+        if tid:
+            # extra JSON key: from_json ignores unknown keys, so old
+            # servers interoperate; new ones join the trace (obs/trace.py)
+            doc = json.loads(payload)
+            doc["trace_id"] = tid
+            payload = json.dumps(doc, separators=(",", ":"), sort_keys=True)
+        async with http.post(self.base + path, data=payload) as resp:
             body = await resp.text()
             try:
                 out = wire.JsonMessage.from_json(body)

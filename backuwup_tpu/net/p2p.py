@@ -36,6 +36,7 @@ except ModuleNotFoundError:  # containers without the wheel: aiohttp shim
 
 from .. import defaults, wire
 from ..crypto import KeyManager, verify_signature
+from ..obs import trace as obs_trace
 from ..store import Store
 from ..utils import faults, retry
 
@@ -84,8 +85,11 @@ class ConnectionRequests:
 
 def _sign_body(keys: KeyManager, body: wire.P2PBody) -> bytes:
     encoded = body.encode_bytes()
-    return wire.EncapsulatedMsg(body=encoded,
-                                signature=keys.sign(encoded)).encode_bytes()
+    # the caller's trace id rides outside the signed body (advisory
+    # correlation metadata — see wire.EncapsulatedMsg)
+    return wire.EncapsulatedMsg(
+        body=encoded, signature=keys.sign(encoded),
+        trace_id=obs_trace.current_trace_id()).encode_bytes()
 
 
 def _verify_msg(raw: bytes, peer_id: bytes) -> wire.P2PBody:
@@ -94,7 +98,12 @@ def _verify_msg(raw: bytes, peer_id: bytes) -> wire.P2PBody:
     msg = wire.EncapsulatedMsg.decode_bytes(raw)
     if not verify_signature(peer_id, msg.body, msg.signature):
         raise P2PError("bad message signature")
-    return wire.P2PBody.decode_bytes(msg.body)
+    body = wire.P2PBody.decode_bytes(msg.body)
+    # ride the sender's trace id alongside the body (frozen dataclass:
+    # a side-channel attribute, never part of equality or encoding)
+    object.__setattr__(body, "trace_id",
+                       obs_trace.clean_trace_id(msg.trace_id))
+    return body
 
 
 class Transport:
@@ -229,7 +238,11 @@ class Receiver:
                 raise P2PError(
                     f"sequence break: got {body.header.sequence_number}, "
                     f"expected {self.expected_seq} (replay protection)")
-            await self.sink(body.file_info, body.file_id, body.data)
+            # adopt the sender's trace id so this store joins its pack/
+            # transfer spans in the journal (the acceptance chain)
+            with obs_trace.bind(getattr(body, "trace_id", None)), \
+                    obs_trace.span("receiver.store"):
+                await self.sink(body.file_info, body.file_id, body.data)
             plane = faults.PLANE
             if plane is not None \
                     and plane.withhold_ack_now(self.t.peer_id):
@@ -515,7 +528,11 @@ class P2PNode:
             raise P2PError("expected a CHALLENGE body on an audit connection")
         if len(body.challenges) > defaults.AUDIT_MAX_CHALLENGES_PER_MSG:
             raise P2PError("too many challenges in one message")
-        proofs = compute_proofs(self.store, backend, peer_id, body.challenges)
+        # join the verifier's audit trace (challenge -> proof in one id)
+        with obs_trace.bind(getattr(body, "trace_id", None)), \
+                obs_trace.span("audit.serve"):
+            proofs = compute_proofs(self.store, backend, peer_id,
+                                    body.challenges)
         reply = wire.P2PBody(
             kind=wire.P2PBodyKind.PROOF,
             header=wire.P2PHeader(

@@ -27,10 +27,33 @@ import sqlite3
 import time
 from typing import Dict, Optional
 
+import json
+
 from aiohttp import WSMsgType, web
 
 from .. import defaults, wire
 from ..crypto import verify_signature
+from ..obs import expo as obs_expo
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+_REQUESTS = obs_metrics.counter(
+    "bkw_server_requests_total", "Coordination-server requests by route",
+    ("path",))
+_QUEUE_DEPTH = obs_metrics.gauge(
+    "bkw_matchmaking_queue_depth",
+    "Storage requests waiting in the matchmaking queue")
+_CONNECTED = obs_metrics.gauge(
+    "bkw_server_connected_clients", "Clients on the WS push channel")
+
+# Families the clients of this process produce into; declared here too
+# (get-or-create merges them) so a standalone server's /metrics always
+# advertises the core catalog even before any client code is imported.
+obs_metrics.histogram("bkw_transfer_send_seconds",
+                      "Seconds spent in ws.send + ack per transfer")
+obs_metrics.counter("bkw_audit_total", "Audit verdicts by outcome",
+                    ("outcome",))
+obs_metrics.counter("bkw_repair_rounds_total", "Peer-loss repair rounds run")
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS clients (
@@ -306,10 +329,15 @@ class Connections:
 
     def register(self, client_id: bytes, ws: web.WebSocketResponse) -> None:
         self._socks[bytes(client_id)] = ws
+        _CONNECTED.set(len(self._socks))
 
     def unregister(self, client_id: bytes, ws: web.WebSocketResponse) -> None:
         if self._socks.get(bytes(client_id)) is ws:
             self._socks.pop(bytes(client_id), None)
+        _CONNECTED.set(len(self._socks))
+
+    def count(self) -> int:
+        return len(self._socks)
 
     def is_online(self, client_id: bytes) -> bool:
         return bytes(client_id) in self._socks
@@ -441,9 +469,31 @@ class StorageQueue:
             if remaining > 0:
                 self._queue.append((bytes(client_id), remaining,
                                     time.time() + self.expiry_s))
+            _QUEUE_DEPTH.set(len(self._queue))
 
     def pending(self) -> int:
-        return len(self._queue)
+        depth = len(self._queue)
+        _QUEUE_DEPTH.set(depth)  # point-in-time refresh for scrapers
+        return depth
+
+
+@web.middleware
+async def _obs_middleware(request, handler):
+    """Per-request observability: count by canonical route (bounded label
+    cardinality) and adopt the client's trace id from the POST JSON so
+    the server-side span journals under the same id as the caller's."""
+    resource = request.match_info.route.resource
+    path = resource.canonical if resource is not None else request.path
+    _REQUESTS.inc(path=path)
+    trace_id = None
+    if request.method == "POST" and request.can_read_body:
+        try:
+            # request.text() caches: handlers re-read the same body
+            trace_id = json.loads(await request.text()).get("trace_id")
+        except (ValueError, UnicodeDecodeError):
+            pass
+    with obs_trace.bind(trace_id), obs_trace.span(f"server{path}"):
+        return await handler(request)
 
 
 class CoordinationServer:
@@ -454,6 +504,7 @@ class CoordinationServer:
         self.queue = StorageQueue(self.db, self.connections)
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
+        self._started = time.time()
 
     # --- helpers -----------------------------------------------------------
 
@@ -620,6 +671,20 @@ class CoordinationServer:
         self.db.reclaim_negotiation(client, peer)
         return self._ok()
 
+    # --- observability exposition (obs/expo.py) -----------------------------
+
+    async def metrics(self, _request):
+        self.queue.pending()  # refresh the queue-depth gauge
+        _CONNECTED.set(self.connections.count())
+        return obs_expo.metrics_response()
+
+    async def healthz(self, _request):
+        return obs_expo.health_response(
+            schema_version=self.db.schema_version(),
+            queue_depth=self.queue.pending(),
+            connected_clients=self.connections.count(),
+            uptime_s=round(time.time() - self._started, 3))
+
     async def ws(self, request):
         token = request.headers.get("Authorization")
         try:
@@ -643,8 +708,11 @@ class CoordinationServer:
     # --- lifecycle ---------------------------------------------------------
 
     def app(self) -> web.Application:
-        app = web.Application(client_max_size=1 << 20)
+        app = web.Application(client_max_size=1 << 20,
+                              middlewares=[_obs_middleware])
         app.add_routes([
+            web.get("/metrics", self.metrics),
+            web.get("/healthz", self.healthz),
             web.post("/register/begin", self.register_begin),
             web.post("/register/complete", self.register_complete),
             web.post("/login/begin", self.login_begin),

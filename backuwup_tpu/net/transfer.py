@@ -39,6 +39,23 @@ from dataclasses import dataclass
 from typing import Awaitable, Callable, Dict, List, Optional
 
 from .. import defaults
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+_WAIT_SECONDS = obs_metrics.histogram(
+    "bkw_transfer_wait_seconds",
+    "Seconds a transfer waits on per-peer ordering + byte admission")
+_SEND_SECONDS = obs_metrics.histogram(
+    "bkw_transfer_send_seconds",
+    "Seconds spent in ws.send + ack per transfer")
+_TRANSFERS = obs_metrics.counter(
+    "bkw_transfers_total", "Completed transfers by outcome", ("outcome",))
+_BYTES_SENT = obs_metrics.counter(
+    "bkw_transfer_bytes_total", "Payload bytes successfully transferred")
+_INFLIGHT = obs_metrics.gauge(
+    "bkw_transfer_inflight", "Transfers currently admitted")
+_INFLIGHT_BYTES = obs_metrics.gauge(
+    "bkw_transfer_inflight_bytes", "Payload bytes currently admitted")
 
 
 @dataclass
@@ -90,11 +107,15 @@ class TransferScheduler:
                 await self._cond.wait()
             self.inflight_count += 1
             self.inflight_bytes += size
+            _INFLIGHT.set(self.inflight_count)
+            _INFLIGHT_BYTES.set(self.inflight_bytes)
 
     async def _release(self, size: int) -> None:
         async with self._cond:
             self.inflight_count -= 1
             self.inflight_bytes -= size
+            _INFLIGHT.set(self.inflight_count)
+            _INFLIGHT_BYTES.set(self.inflight_bytes)
             self._cond.notify_all()
 
     # --- submission --------------------------------------------------------
@@ -120,7 +141,11 @@ class TransferScheduler:
             await self._admit(size)
             t1 = time.monotonic()
             try:
-                await send()
+                # the span inherits the submitting backup's trace id (the
+                # contextvar copied into this task at submit time) and is
+                # what _sign_body stamps onto the envelope
+                with obs_trace.span("transfer.send"):
+                    await send()
                 result = TransferResult(peer_id, size, True, label=label)
             except (Exception, asyncio.TimeoutError) as e:
                 result = TransferResult(peer_id, size, False, error=e,
@@ -132,9 +157,13 @@ class TransferScheduler:
         result.send_s = t2 - t1
         self.stage_s["wait"] += result.wait_s
         self.stage_s["send"] += result.send_s
+        _WAIT_SECONDS.observe(result.wait_s)
+        _SEND_SECONDS.observe(result.send_s)
+        _TRANSFERS.inc(outcome="sent" if result.ok else "failed")
         if result.ok:
             self.completed += 1
             self.bytes_sent += size
+            _BYTES_SENT.inc(size)
         else:
             self.failed += 1
         if self.messenger is not None:

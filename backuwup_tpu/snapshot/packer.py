@@ -27,12 +27,16 @@ from pathlib import Path
 from typing import Callable, List, Optional
 
 from .. import defaults
+from ..obs import metrics as obs_metrics
 from ..ops.backend import ChunkerBackend
 from ..ops.blake3_cpu import blake3_hash
 from ..utils import tracing
 from ..wire import Blob, BlobKind, Tree, TreeKind, TreeMetadata
 from .blob_index import BlobIndex
 from .packfile import PackfileWriter
+
+_STAGE_SECONDS = obs_metrics.histogram(
+    "bkw_pack_stage_seconds", "", ("stage",))  # declared in packfile.py
 
 
 @dataclass
@@ -142,7 +146,9 @@ class DirPacker:
             t0 = time.monotonic()
             with tracing.span("packer.manifest_many"):
                 manifests = self.backend.manifest_many(batch_data)
-            self.stats.chunk_hash_s += time.monotonic() - t0
+            dt = time.monotonic() - t0
+            self.stats.chunk_hash_s += dt
+            _STAGE_SECONDS.observe(dt, stage="chunk_hash")
             hints = iter(())
             if self.dedup_batch is not None:
                 # blobs classified host-side since the last batch (streamed
@@ -252,7 +258,9 @@ class DirPacker:
                         # window slices; closing would mask the real
                         # error — let GC drop the mapping instead
                         pass
-        self.stats.chunk_hash_s += time.monotonic() - t0
+        dt = time.monotonic() - t0
+        self.stats.chunk_hash_s += dt
+        _STAGE_SECONDS.observe(dt, stage="chunk_hash")
         self.stats.files += 1
         self.progress(file=str(path), bytes=st.st_size)
         return self._tree_with_split(

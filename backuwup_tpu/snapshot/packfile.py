@@ -43,6 +43,7 @@ except ModuleNotFoundError:  # containers without the wheel: libcrypto shim
 
 from .. import defaults
 from ..crypto import KeyManager
+from ..obs import metrics as obs_metrics
 from ..utils import zstd
 from ..utils.serialization import Reader, Writer
 from ..wire import (
@@ -54,6 +55,13 @@ from ..wire import (
 
 HEADER_KEY_INFO = b"header"
 NONCE_LEN = 12
+
+_STAGE_SECONDS = obs_metrics.histogram(
+    "bkw_pack_stage_seconds",
+    "Packfile pipeline stage times (seal=zstd+AES-GCM per blob,"
+    " write=assemble+fsync per packfile, stall=packer blocked on the"
+    " double buffer, chunk_hash=CDC+fingerprint per stream)",
+    ("stage",))
 
 
 class PackfileError(Exception):
@@ -180,8 +188,10 @@ class PackfileWriter:
         header = PackfileHeaderBlob(
             hash=blob_hash, kind=kind, compression=comp_kind,
             length=len(record), offset=0)  # offset assigned at write time
+        dt = time.monotonic() - t0
         with self._stats_lock:
-            self.stage_seconds["seal"] += time.monotonic() - t0
+            self.stage_seconds["seal"] += dt
+        _STAGE_SECONDS.observe(dt, stage="seal")
         return _Pending(header, record, len(data))
 
     def add_blob(self, blob: Blob) -> None:
@@ -231,8 +241,10 @@ class PackfileWriter:
         t0 = time.monotonic()
         while len(self._writes) >= max(1, defaults.PACK_SEAL_QUEUE_PACKFILES):
             self._writes.popleft().result()
+        dt = time.monotonic() - t0
         with self._stats_lock:
-            self.stage_seconds["stall"] += time.monotonic() - t0
+            self.stage_seconds["stall"] += dt
+        _STAGE_SECONDS.observe(dt, stage="stall")
         self._writes.append(self._write_pool.submit(
             self._assemble_batch, batch))
 
@@ -312,9 +324,11 @@ class PackfileWriter:
                 f.write(p.record)
         os.replace(tmp, path)
         size = path.stat().st_size
+        dt = time.monotonic() - t0
         with self._stats_lock:
             self.bytes_written += size
-            self.stage_seconds["write"] += time.monotonic() - t0
+            self.stage_seconds["write"] += dt
+        _STAGE_SECONDS.observe(dt, stage="write")
         hashes = [h.hash for h in headers]
         assert size <= self._cap, "cap enforced before write"
         if self.on_packfile is not None:

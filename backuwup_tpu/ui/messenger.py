@@ -5,17 +5,43 @@ of log lines, lifecycle events, and debounced progress snapshots that UI
 front-ends (CLI, web dashboard, tests) subscribe to.  Progress updates are
 coalesced to at most one per 100 ms (``:134-141``); subscribers are
 lag-tolerant bounded queues (``ui/ws.rs:31-56``).
+
+Every event also flows into the observability plane: the journal (when
+installed) records each StatusEvent as one JSONL line, and per-kind /
+per-outcome counters land in the metrics registry so ``GET /metrics``
+carries the audit/erasure/transfer story without a UI attached.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import time
 from collections import deque
 from dataclasses import asdict, dataclass, field
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from .. import defaults
+from ..obs import journal as obs_journal
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+logger = logging.getLogger(__name__)
+
+_EVENTS = obs_metrics.counter(
+    "bkw_messenger_events_total", "StatusEvents emitted by kind", ("kind",))
+_SUB_ERRORS = obs_metrics.counter(
+    "bkw_messenger_subscriber_errors_total",
+    "Events dropped by a raising subscriber callback", ("subscriber",))
+_AUDITS = obs_metrics.counter(
+    "bkw_audit_total", "Audit verdicts by outcome", ("outcome",))
+_ERASURE = obs_metrics.counter(
+    "bkw_erasure_events_total", "Erasure-coding events by outcome",
+    ("outcome",))
+
+
+def _sub_label(cb: Callable) -> str:
+    return getattr(cb, "__qualname__", None) or repr(cb)
 
 
 @dataclass
@@ -50,6 +76,7 @@ class Messenger:
         self._last_progress = 0.0
         self.progress_state = Progress()
         self.history: deque = deque(maxlen=history)
+        self._sub_logged: set = set()  # subscribers whose first failure logged
 
     def subscribe(self, cb: Callable[[StatusEvent], None]) -> Callable:
         self._subs.append(cb)
@@ -57,11 +84,24 @@ class Messenger:
 
     def _emit(self, event: StatusEvent) -> None:
         self.history.append(event)
+        _EVENTS.inc(kind=event.kind)
+        obs_journal.emit("status", event=event.kind, payload=event.payload,
+                         trace_id=obs_trace.current_trace_id())
         for cb in list(self._subs):
             try:
                 cb(event)
             except Exception:
-                pass  # lag-tolerant: a broken subscriber never blocks others
+                # lag-tolerant: a broken subscriber never blocks others —
+                # but the drops are counted, and the first failure per
+                # subscriber is logged so it cannot stay invisible forever
+                label = _sub_label(cb)
+                _SUB_ERRORS.inc(subscriber=label)
+                if label not in self._sub_logged:
+                    self._sub_logged.add(label)
+                    logger.exception(
+                        "messenger subscriber %s raised on %s event"
+                        " (first failure; further drops only counted)",
+                        label, event.kind)
 
     # --- producers ---------------------------------------------------------
 
@@ -97,6 +137,7 @@ class Messenger:
     def audit(self, peer: str, outcome: str, detail: str = "",
               demoted: bool = False) -> None:
         """Storage-audit verdict frame (outcome: pass | fail | miss)."""
+        _AUDITS.inc(outcome=outcome)
         self._emit(StatusEvent("audit", {"peer": peer, "outcome": outcome,
                                          "detail": detail,
                                          "demoted": demoted}))
@@ -105,6 +146,7 @@ class Messenger:
                 rebuilt: int = 0) -> None:
         """Erasure-coding telemetry frame (outcome: placed | assembled |
         rebuilt); ``subject`` is a packfile id hex or a phase label."""
+        _ERASURE.inc(outcome=outcome)
         self._emit(StatusEvent("erasure", {"subject": subject,
                                            "outcome": outcome,
                                            "shards": shards,
@@ -113,7 +155,7 @@ class Messenger:
     def transfer(self, peer: str, outcome: str, size: int = 0,
                  inflight: int = 0, inflight_bytes: int = 0,
                  wait_ms: float = 0.0, send_ms: float = 0.0,
-                 label: str = "", stages: Optional[dict] = None) -> None:
+                 label: str = "", stages: Optional[Dict] = None) -> None:
         """Transfer-plane telemetry frame (net/transfer.py).
 
         ``outcome``: ``sent`` | ``failed`` per completed transfer, or
@@ -133,12 +175,19 @@ class Messenger:
     def error(self, text: str) -> None:
         self._emit(StatusEvent("error", {"text": text}))
 
+    def _flush_progress(self) -> None:
+        """Undebounced final snapshot: a run's last progress must never be
+        eaten by the debounce window (UIs would end on a stale percent)."""
+        self._last_progress = time.time()
+        self._emit(StatusEvent("progress", asdict(self.progress_state)))
+
     def backup_started(self) -> None:
         self.progress_state = Progress(running=True)
         self._emit(StatusEvent("backup_started"))
 
     def backup_finished(self, snapshot: bytes) -> None:
         self.progress_state.running = False
+        self._flush_progress()
         self._emit(StatusEvent("backup_finished",
                                {"snapshot": bytes(snapshot).hex()}))
 
@@ -148,8 +197,11 @@ class Messenger:
 
     def restore_finished(self) -> None:
         self.progress_state.running = False
+        self._flush_progress()
         self._emit(StatusEvent("restore_finished"))
 
     def panic(self, message: str) -> None:
-        """Fatal-error report hook (client main.rs:53-61 panic hook)."""
+        """Fatal-error report hook (client main.rs:53-61 panic hook):
+        besides the UI frame, trip the journal's flight-recorder dump."""
         self._emit(StatusEvent("panic", {"text": message}))
+        obs_journal.panic(message)

@@ -40,9 +40,23 @@ import os
 import random
 from typing import Dict, Optional, Set
 
+from ..obs import journal as obs_journal
+from ..obs import metrics as obs_metrics
+
 #: send_data asks this before shipping a FILE frame
 ACT_DROP = "drop"
 ACT_CORRUPT = "corrupt"
+
+_INJECTIONS = obs_metrics.counter(
+    "bkw_fault_injections_total", "Fault-plane firings by hook site",
+    ("site",))
+
+
+def _record_injection(site: str) -> None:
+    # metric label is the hook prefix (site minus the ':<peer hex>' tail)
+    # so cardinality stays bounded; the journal keeps the full site
+    _INJECTIONS.inc(site=site.split(":", 1)[0])
+    obs_journal.emit("fault", site=site)
 
 
 def _site_seed(seed: int, site: str) -> int:
@@ -103,6 +117,7 @@ class FaultPlane:
             self._rng(site).random()
         if hit:
             self.fired[site] = self.fired.get(site, 0) + 1
+            _record_injection(site)
         return hit
 
     # --- peer death ---------------------------------------------------------
@@ -146,6 +161,7 @@ class FaultPlane:
         if self._count_send(peer_id) or self.is_dead(peer_id):
             self.fired[f"send.dead:{hexid}"] = \
                 self.fired.get(f"send.dead:{hexid}", 0) + 1
+            _record_injection(f"send.dead:{hexid}")
             return ACT_DROP
         if self.decide(f"send.drop:{hexid}", self.drop_send):
             return ACT_DROP

@@ -30,6 +30,18 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Tuple, Type
 
 from .. import defaults
+from ..obs import journal as obs_journal
+from ..obs import metrics as obs_metrics
+
+_ATTEMPTS = obs_metrics.counter(
+    "bkw_retry_attempts_total", "Retry/backoff firings by named policy",
+    ("policy",))
+
+
+def _record_attempt(policy: "RetryPolicy", attempt: int) -> None:
+    label = policy.name or "anonymous"
+    _ATTEMPTS.inc(policy=label)
+    obs_journal.emit("retry", policy=label, attempt=attempt)
 
 
 @dataclass(frozen=True)
@@ -41,6 +53,7 @@ class RetryPolicy:
     multiplier: float = 2.0
     jitter: float = defaults.RETRY_JITTER  # +/- fraction of the raw delay
     max_attempts: Optional[int] = None  # retries allowed; None = unbounded
+    name: str = ""  # metric/journal label; last so positional sites hold
 
     def delay_s(self, attempt: int,
                 rand: Optional[Callable[[], float]] = None) -> float:
@@ -80,6 +93,7 @@ class Backoff:
         if self.policy.max_attempts is not None \
                 and self.attempt > self.policy.max_attempts:
             return None
+        _record_attempt(self.policy, self.attempt)
         return self.policy.delay_s(self.attempt, self._rand)
 
     async def sleep(self) -> bool:
@@ -113,6 +127,7 @@ class RetryTimer:
 
     def fire(self, now: float) -> None:
         self.attempt += 1
+        _record_attempt(self.policy, self.attempt)
         self._next_at = now + self.policy.delay_s(self.attempt, self._rand)
 
     def reset(self) -> None:
@@ -143,27 +158,32 @@ async def retry_async(fn, policy: RetryPolicy, *,
 #: p2p dial retries (handle_connections.rs:145-165 hardcoded 3 tries/0.5 s).
 DIAL = RetryPolicy(base_s=defaults.DIAL_RETRY_BASE_S,
                    cap_s=defaults.DIAL_RETRY_CAP_S,
-                   max_attempts=defaults.DIAL_RETRY_ATTEMPTS)
+                   max_attempts=defaults.DIAL_RETRY_ATTEMPTS,
+                   name="dial")
 
 #: server push-channel reconnect (net_server/mod.rs:26-55 hardcoded 0.2 s).
 WS_RECONNECT = RetryPolicy(base_s=defaults.WS_RECONNECT_BASE_S,
-                           cap_s=defaults.WS_RECONNECT_CAP_S)
+                           cap_s=defaults.WS_RECONNECT_CAP_S,
+                           name="ws_reconnect")
 
 #: storage-request re-issue while no peer has room (send.rs:296-309).
 STORAGE_REQUEST = RetryPolicy(base_s=defaults.STORAGE_REQUEST_RETRY_S,
-                              cap_s=defaults.STORAGE_REQUEST_RETRY_CAP_S)
+                              cap_s=defaults.STORAGE_REQUEST_RETRY_CAP_S,
+                              name="storage_request")
 
 #: send-loop pacing while waiting for the packer to produce.
 SEND_IDLE = RetryPolicy(base_s=defaults.SEND_IDLE_BASE_S,
-                        cap_s=defaults.SEND_IDLE_CAP_S)
+                        cap_s=defaults.SEND_IDLE_CAP_S,
+                        name="send_idle")
 
 #: send-loop pacing while waiting for a usable peer.
 PEER_WAIT = RetryPolicy(base_s=defaults.PEER_WAIT_BASE_S,
-                        cap_s=defaults.PEER_WAIT_CAP_S)
+                        cap_s=defaults.PEER_WAIT_CAP_S,
+                        name="peer_wait")
 
 #: audit ledger re-audit schedule after a miss/failure.  jitter=0: the
 #: ledger persists absolute ``next_due`` times that tests (and operators
 #: reading the ledger) must be able to predict exactly.
 AUDIT = RetryPolicy(base_s=defaults.AUDIT_RETRY_BASE_S,
                     cap_s=defaults.AUDIT_BACKOFF_CAP_S,
-                    jitter=0.0)
+                    jitter=0.0, name="audit")

@@ -98,6 +98,9 @@ class Reader:
             raise CodecError(f"bad option tag {flag}")
         return self.fixed(length)
 
+    def remaining(self) -> int:
+        return len(self._buf) - self._pos
+
     def expect_end(self) -> None:
         if self._pos != len(self._buf):
             raise CodecError(f"{len(self._buf) - self._pos} trailing bytes")

@@ -1,104 +1,42 @@
-"""Host tracing + JAX-profiler hooks (SURVEY §5.1: the reference has no
-tracing at all — println! only — and the survey directs this build to add
-real instrumentation).
+"""Backward-compat facade over :mod:`backuwup_tpu.obs.trace`.
 
-* :func:`span` — a contextmanager/decorator accumulating wall-clock per
-  named section into a process-wide registry (thread-safe, negligible
-  overhead when disabled).
-* :func:`report` — snapshot of {name: (calls, total_s)} for logs/UI.
-* :func:`jax_profiler` — wraps ``jax.profiler.trace`` so a device trace
-  can be captured around any section when ``BKW_TRACE_DIR`` is set
-  (viewable in TensorBoard/Perfetto); a no-op otherwise, so production
-  paths can keep the call sites unconditionally.
+The original host-tracing module (SURVEY §5.1) grew into the unified
+observability plane: spans now carry trace/span ids that propagate
+across threads, tasks, and the wire, feed the ``bkw_span_seconds``
+histogram, and journal their closes.  Everything here simply re-exports
+the obs implementation so the dozens of ``from ..utils import tracing``
+call sites (and external scripts) keep working unchanged:
 
-Enable span collection with ``BKW_TRACE=1`` (or ``enable(True)``).
+* ``span``/``traced``/``report``/``reset``/``format_report`` — the flat
+  ``{name: (calls, total_s)}`` aggregate table, still gated on
+  ``BKW_TRACE=1`` / :func:`enable` exactly as before (the id/histogram/
+  journal mechanics run regardless of the gate);
+* ``jax_profiler`` — unchanged ``BKW_TRACE_DIR`` device-trace hook.
+
+New code should import :mod:`backuwup_tpu.obs.trace` directly.
 """
 
 from __future__ import annotations
 
-import contextlib
-import functools
-import os
-import threading
-import time
-from typing import Dict, Iterator, Tuple
+from ..obs.trace import (  # noqa: F401  (re-exported API)
+    bind,
+    current,
+    current_span_id,
+    current_trace_id,
+    enable,
+    enabled,
+    format_report,
+    jax_profiler,
+    new_span_id,
+    new_trace_id,
+    report,
+    reset,
+    span,
+    traced,
+)
 
-_lock = threading.Lock()
-_spans: Dict[str, Tuple[int, float]] = {}
-_enabled = os.environ.get("BKW_TRACE", "0") == "1"
-
-
-def enable(on: bool = True) -> None:
-    global _enabled
-    _enabled = on
-
-
-def enabled() -> bool:
-    return _enabled
-
-
-@contextlib.contextmanager
-def span(name: str) -> Iterator[None]:
-    """Accumulate wall time under ``name`` (no-op unless enabled)."""
-    if not _enabled:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        with _lock:
-            calls, total = _spans.get(name, (0, 0.0))
-            _spans[name] = (calls + 1, total + dt)
-
-
-def traced(name: str = None):
-    """Decorator form of :func:`span`."""
-
-    def deco(fn):
-        label = name or f"{fn.__module__}.{fn.__qualname__}"
-
-        @functools.wraps(fn)
-        def wrapper(*args, **kw):
-            with span(label):
-                return fn(*args, **kw)
-
-        return wrapper
-
-    return deco
-
-
-def report() -> Dict[str, Tuple[int, float]]:
-    with _lock:
-        return dict(_spans)
-
-
-def reset() -> None:
-    with _lock:
-        _spans.clear()
-
-
-def format_report() -> str:
-    rows = sorted(report().items(), key=lambda kv: -kv[1][1])
-    if not rows:
-        return "no spans recorded (BKW_TRACE=1 to enable)"
-    width = max(len(k) for k, _ in rows)
-    out = []
-    for name, (calls, total) in rows:
-        out.append(f"{name:<{width}}  {calls:>6}x  {total * 1e3:>10.1f} ms")
-    return "\n".join(out)
-
-
-@contextlib.contextmanager
-def jax_profiler(section: str = "trace") -> Iterator[None]:
-    """Capture a device profile into ``$BKW_TRACE_DIR/<section>`` when the
-    env var is set; no-op (zero overhead) otherwise."""
-    trace_dir = os.environ.get("BKW_TRACE_DIR")
-    if not trace_dir:
-        yield
-        return
-    import jax
-
-    with jax.profiler.trace(os.path.join(trace_dir, section)):
-        yield
+__all__ = [
+    "bind", "current", "current_span_id", "current_trace_id", "enable",
+    "enabled", "format_report", "jax_profiler", "new_span_id",
+    "new_trace_id", "report", "reset", "span", "traced",
+]

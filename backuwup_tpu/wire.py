@@ -625,15 +625,25 @@ class P2PBody:
 
 @dataclass(frozen=True)
 class EncapsulatedMsg:
-    """Signed envelope for every p2p message (p2p_message.rs:12-17)."""
+    """Signed envelope for every p2p message (p2p_message.rs:12-17).
+
+    ``trace_id`` is an optional trailing frame carrying the sender's
+    observability trace id (obs/trace.py).  It sits OUTSIDE the signed
+    body on purpose: it is advisory correlation metadata, never input to
+    any decision, so it needs no authentication — and old peers that
+    stop reading after the signature still interoperate (the field is
+    only decoded when bytes remain)."""
 
     body: bytes  # encoded P2PBody
     signature: bytes  # Ed25519 signature over body
+    trace_id: Optional[str] = None  # unauthenticated, advisory
 
     def encode_bytes(self) -> bytes:
         w = Writer()
         w.blob(self.body)
         w.blob(self.signature)
+        if self.trace_id:
+            w.str(self.trace_id)
         return w.take()
 
     @classmethod
@@ -641,5 +651,10 @@ class EncapsulatedMsg:
         r = Reader(buf)
         body = r.blob()
         sig = r.blob()
+        trace_id = None
+        if r.remaining():
+            tid = r.str()
+            if len(tid) <= 32 and all(c in "0123456789abcdef" for c in tid):
+                trace_id = tid or None
         r.expect_end()
-        return cls(body=body, signature=sig)
+        return cls(body=body, signature=sig, trace_id=trace_id)

@@ -44,6 +44,14 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _metrics_snapshot() -> dict:
+    """Registry state at report time, embedded in every BENCH record so a
+    run's counters/histograms (pack stages, retries, faults) ride along
+    with the headline number."""
+    from backuwup_tpu.obs import metrics as obs_metrics
+    return obs_metrics.registry().snapshot()
+
+
 def main() -> None:
     from backuwup_tpu.utils.jaxcache import enable_compilation_cache
     enable_compilation_cache()
@@ -117,7 +125,8 @@ def main() -> None:
     tpu_digest_bytes = [bytes(d) for d in tpu_digests]
     if tpu_chunks != cpu_chunks or tpu_digest_bytes != cpu_digests:
         print(json.dumps({"metric": "chunk+hash parity FAILED", "value": 0.0,
-                          "unit": "MiB/s", "vs_baseline": 0.0}))
+                          "unit": "MiB/s", "vs_baseline": 0.0,
+                          "metrics": _metrics_snapshot()}))
         return
     dedup = len(set(cpu_digests)) / len(cpu_digests)
     log(f"parity OK: {len(cpu_chunks)} chunks, unique-ratio {dedup:.3f}")
@@ -171,7 +180,8 @@ def main() -> None:
         if nat_chunks != cpu_chunks or nat_digests != cpu_digests:
             print(json.dumps({"metric": "native baseline parity FAILED",
                               "value": 0.0, "unit": "MiB/s",
-                              "vs_baseline": 0.0}))
+                              "vs_baseline": 0.0,
+                              "metrics": _metrics_snapshot()}))
             return
         cpu_s = min(_timed(native.manifest_native, host, params)
                     for _ in range(3))
@@ -214,6 +224,7 @@ def main() -> None:
         "note": "corpus synthesized on-device (host<->device relay tunnel "
                 "~6 MiB/s would measure the tunnel, not the kernels); "
                 "parity vs CPU oracle gated per config",
+        "metrics": _metrics_snapshot(),
     }))
 
 
@@ -260,7 +271,8 @@ def _cpu_fallback_report() -> None:
                  "see BENCH_INIT_TIMEOUT_S",
         "note": "HOST-pipeline measurement — the device never initialized;"
                 " PERF.md and the last BENCH_r*.json hold the most recent"
-                " device numbers"}))
+                " device numbers",
+        "metrics": _metrics_snapshot()}))
 
 
 def _timed(fn, *args):

@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""Operator CLI for the observability plane (docs/observability.md).
+
+Three read-only views, no accelerator and no repo imports beyond stdlib:
+
+* ``--url http://HOST:PORT`` — fetch ``/metrics`` from a coordination
+  server or a client status listener (BKW_STATUS_PORT) and print the
+  non-zero samples, one per line.
+* ``--journal PATH [-n N]`` — tail the last N parsed lines of a JSONL
+  journal written under ``BKW_JOURNAL``; ``--trace TID`` filters to one
+  correlated trace.
+* ``--panic PATH`` — pretty-print a ``<journal>.panic.json`` flight-
+  recorder dump (metrics snapshot + journal tail at panic time).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+
+def dump_metrics(url: str, raw: bool) -> int:
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        text = resp.read().decode("utf-8", "replace")
+    if raw:
+        sys.stdout.write(text)
+        return 0
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        # keep the catalog readable: hide never-touched zero samples
+        # (bucket cumulative zeros, un-fired counters)
+        try:
+            value = float(line.rsplit(" ", 1)[1])
+        except (IndexError, ValueError):
+            value = 1.0
+        if value != 0.0:
+            print(line)
+    return 0
+
+
+def dump_journal(path: str, lines: int, trace: str) -> int:
+    kept = []
+    with open(path, "r", encoding="utf-8") as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                doc = json.loads(raw)
+            except ValueError:
+                continue  # torn tail line from a crash mid-write
+            if trace and doc.get("trace_id") != trace:
+                continue
+            kept.append(doc)
+    for doc in kept[-lines:]:
+        print(json.dumps(doc, sort_keys=True))
+    return 0
+
+
+def dump_panic(path: str) -> int:
+    with open(path, "r", encoding="utf-8") as f:
+        print(json.dumps(json.load(f), indent=2, sort_keys=True))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="base URL of a /metrics endpoint")
+    src.add_argument("--journal", help="path to a BKW_JOURNAL JSONL file")
+    src.add_argument("--panic", help="path to a <journal>.panic.json dump")
+    ap.add_argument("-n", "--lines", type=int, default=50,
+                    help="journal lines to show (default 50)")
+    ap.add_argument("--trace", default="",
+                    help="only journal lines with this trace_id")
+    ap.add_argument("--raw", action="store_true",
+                    help="with --url: full exposition incl. zero samples")
+    args = ap.parse_args(argv)
+    if args.url:
+        return dump_metrics(args.url, args.raw)
+    if args.journal:
+        return dump_journal(args.journal, args.lines, args.trace)
+    return dump_panic(args.panic)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # piped into head etc.
+        os.close(sys.stdout.fileno())
+        sys.exit(0)
