@@ -18,6 +18,7 @@ from .crypto import KeyManager
 from .engine import Engine
 from .net.client import ServerClient
 from .net.p2p import P2PNode, ReceivedFilesWriter, Receiver
+from .obs.invariants import InvariantMonitor
 from .ops.backend import ChunkerBackend
 from .store import Store
 from .ui.messenger import Messenger
@@ -76,7 +77,10 @@ class ClientApp:
         self.engine = Engine(self.keys, self.store, self.server, self.node,
                              backend=backend, messenger=self.messenger,
                              dedup_mesh=dedup_mesh)
+        self.monitor = InvariantMonitor(self.store, index=self.engine.index,
+                                        client=self.client_id.hex()[:8])
         self._audit_task: Optional[asyncio.Task] = None
+        self._monitor_task: Optional[asyncio.Task] = None
         if status_port is None:
             env_port = os.environ.get("BKW_STATUS_PORT", "")
             status_port = int(env_port) if env_port else None
@@ -107,13 +111,18 @@ class ClientApp:
         await asyncio.wait_for(self.server.ws_connected.wait(), 10)
         self._audit_task = asyncio.create_task(
             self.engine.audit_scheduler())
+        self._monitor_task = asyncio.create_task(self.monitor.run())
         if self._status_port_req is not None:
             from .obs.expo import StatusServer
             self._status_server = StatusServer(
                 port=self._status_port_req,
                 health_fn=lambda: {
                     "client_id": self.client_id.hex(),
-                    "busy": self.engine._exclusive.locked()})
+                    "busy": self.engine._exclusive.locked(),
+                    # sweep on demand: health is never staler than the ask
+                    "durability": self.monitor.sweep().summary,
+                    "status": self.monitor.last_report.status},
+                before_metrics=lambda: self.monitor.sweep())
             self.status_port = await self._status_server.start()
             self.messenger.log(
                 f"status listener on 127.0.0.1:{self.status_port}")
@@ -131,6 +140,13 @@ class ClientApp:
             except (asyncio.CancelledError, Exception):
                 pass
             self._audit_task = None
+        if self._monitor_task is not None:
+            self._monitor_task.cancel()
+            try:
+                await self._monitor_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._monitor_task = None
         await self.engine.aclose()
         await self.server.close()
         self.store.close()

@@ -127,6 +127,15 @@ OBS_JOURNAL_MAX_BYTES = 4 * MiB  # rotate the JSONL journal past this size
 OBS_JOURNAL_KEEP = 3  # rotated generations retained (<path>.1 .. .keep)
 OBS_PANIC_TAIL_LINES = 200  # journal lines embedded in a panic dump
 
+# --- durability invariant monitor (obs/invariants.py, docs/scenarios.md) -----
+# Background sweep cadence of the client's InvariantMonitor; health is
+# current within one interval of any placement/ledger change.
+DURABILITY_SWEEP_INTERVAL_S = 5.0
+# Stalest tolerated attestation over a placement-holding peer before the
+# monitor reports degraded audit coverage (4x the audit cadence: one
+# missed round is routine backoff, four is a stuck verifier).
+DURABILITY_AUDIT_MAX_AGE_S = 4 * AUDIT_INTERVAL_S
+
 # --- server-side TTLs (reference server/src/client_auth_manager.rs:17-20) ---
 AUTH_CHALLENGE_TTL_S = 30.0
 SESSION_TTL_S = 24 * 3600.0

@@ -49,6 +49,7 @@ from .erasure import stripe as rs_stripe
 from .net.client import NoBackups, ServerClient, ServerError
 from .net.p2p import P2PError, P2PNode, Receiver, RestoreFilesWriter, Transport
 from .net.transfer import TransferScheduler
+from .obs import invariants as obs_invariants
 from .obs import metrics as obs_metrics
 from .obs import trace as obs_trace
 from .ops.backend import ChunkerBackend, select_backend
@@ -71,6 +72,13 @@ _AUDIT_ROUNDS = obs_metrics.counter(
     "bkw_audit_rounds_total", "Audit rounds run")
 _REPAIR_ROUNDS = obs_metrics.counter(
     "bkw_repair_rounds_total", "Peer-loss repair rounds run")
+_SHARDS_REBUILT = obs_metrics.counter(
+    "bkw_repair_shards_rebuilt_total",
+    "Erasure shards rebuilt sourcelessly and re-homed")
+_BUSY_REJECTS = obs_metrics.counter(
+    "bkw_engine_busy_rejections_total",
+    "Backup/restore/repair attempts rejected while the engine was busy",
+    ("op",))
 
 
 def _registry_stage_sums() -> Dict[str, float]:
@@ -258,6 +266,7 @@ class Engine:
 
     async def run_backup(self, root: Optional[Path] = None) -> bytes:
         if self._exclusive.locked():
+            _BUSY_REJECTS.inc(op="backup")
             raise EngineError("a backup or restore is already running")
         async with self._exclusive:
             with obs_trace.span("engine.backup"):
@@ -939,6 +948,7 @@ class Engine:
         reported to the coordination server.
         """
         if self._exclusive.locked():
+            _BUSY_REJECTS.inc(op="repair")
             raise EngineError("a backup or restore is already running")
         async with self._exclusive:
             _REPAIR_ROUNDS.inc()
@@ -946,19 +956,10 @@ class Engine:
                 return await self._repair_round_locked(now)
 
     def _lost_peers(self, now: float) -> set:
-        """Peers holding placements that are demoted or dark past deadline."""
-        lost = set()
-        for peer in self.store.peers_with_placements():
-            peer = bytes(peer)
-            st = self.store.get_audit_state(peer)
-            if st.demoted:
-                lost.add(peer)
-                continue
-            info = self.store.get_peer(peer)
-            if info is not None and info.last_seen is not None and \
-                    now - info.last_seen > defaults.PEER_DARK_DEADLINE_S:
-                lost.add(peer)
-        return lost
+        """Peers holding placements that are demoted or dark past
+        deadline — the shared definition in obs/invariants.py, so the
+        repair plane and the durability monitor can never disagree."""
+        return obs_invariants.lost_peers(self.store, now)
 
     async def _repair_round_locked(self, now: Optional[float]) -> Dict:
         now = time.time() if now is None else now
@@ -966,8 +967,6 @@ class Engine:
         report: Dict = {"peers": {}, "packfiles": 0, "bytes_lost": 0,
                         "bytes_replaced": 0, "blobs": 0,
                         "shards_rebuilt": 0}
-        if not lost:
-            return report
         # a packfile is orphaned only if EVERY replica is on a lost peer;
         # a lost erasure shard whose stripe keeps live holders goes to the
         # sourceless rebuild path instead (no local source tree needed)
@@ -990,6 +989,12 @@ class Engine:
                     stripe_lost.setdefault(pidb, {})[idx] = (peer, size)
                 # idx < 0 with live holders: another whole replica
                 # survives — nothing to rebuild, the row just retires
+        unsent_pids = {bytes(pid)
+                       for pid, _path, _size in self._unsent_packfiles()}
+        self._queue_underplaced_stripes(stripe_lost, orphaned, lost,
+                                        unsent_pids)
+        if not lost and not stripe_lost and not unsent_pids:
+            return report
         shards_rebuilt = 0
         shard_bytes_replaced = 0
         if stripe_lost:
@@ -1059,6 +1064,42 @@ class Engine:
             "shards_rebuilt": shards_rebuilt})
         self._log(f"repair complete: {bytes_replaced} bytes re-replicated")
         return report
+
+    def _queue_underplaced_stripes(self, stripe_lost: Dict, orphaned: Dict,
+                                   lost: set, unsent_pids: set) -> None:
+        """Queue stripes that are short a shard with NO lost row to blame
+        — the scar a partially re-homed repair round leaves ("stripe
+        stays degraded until peers join").  Without this, no later round
+        would ever look at them: the dead rows are already retired, so
+        the lost-peer walk comes up empty while the stripe sits one
+        failure closer to unrestorable.  The missing indexes take the
+        same sourceless rebuild path; the synthetic rows carry no dead
+        peer to retire (``b""``) and a sibling shard's size as the
+        estimate.  Stripes whose packfile still sits locally unsent
+        (``unsent_pids``) are skipped — the leftover drain finishes them
+        from the local bytes, which is cheaper than pulling k shards.
+        """
+        n = defaults.RS_K + defaults.RS_M
+        by_pid: Dict[bytes, list] = {}
+        for pid, peer, size, idx, _sent in self.store.all_placements():
+            if idx >= 0:
+                by_pid.setdefault(bytes(pid), []).append(
+                    (bytes(peer), int(size), int(idx)))
+        for pidb, rows in by_pid.items():
+            if pidb in orphaned or pidb in unsent_pids:
+                continue
+            live = {idx for peer, _s, idx in rows if peer not in lost}
+            if not live:
+                continue  # every row lost: the orphan/repack walk owns it
+            expected = max(n, max(idx for _p, _s, idx in rows) + 1)
+            queued = stripe_lost.get(pidb, {})
+            missing = set(range(expected)) - live - set(queued)
+            if not missing:
+                continue
+            est = max(s for _p, s, _i in rows)
+            entry = stripe_lost.setdefault(pidb, {})
+            for idx in sorted(missing):
+                entry[idx] = (b"", est)
 
     async def _rebuild_lost_shards(self, stripe_lost: Dict, lost: set):
         """Sourceless shard repair: pull each damaged stripe's surviving
@@ -1144,6 +1185,7 @@ class Engine:
                         pairs, await sched.gather(tasks)):
                     if r.ok:
                         rebuilt += 1
+                        _SHARDS_REBUILT.inc()
                         placed_here += 1
                         placed_bytes += len(new_shards[idx])
                     elif isinstance(r.error, P2PError):
@@ -1230,6 +1272,7 @@ class Engine:
 
     async def run_restore(self, dest: Optional[Path] = None) -> Path:
         if self._exclusive.locked():
+            _BUSY_REJECTS.inc(op="restore")
             raise EngineError("a backup or restore is already running")
         async with self._exclusive:
             with obs_trace.span("engine.restore"):

@@ -34,6 +34,7 @@ from aiohttp import WSMsgType, web
 from .. import defaults, wire
 from ..crypto import verify_signature
 from ..obs import expo as obs_expo
+from ..obs import invariants as obs_invariants
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 
@@ -679,11 +680,21 @@ class CoordinationServer:
         return obs_expo.metrics_response()
 
     async def healthz(self, _request):
+        """Liveness plus the durability invariant summary.  The summary
+        aggregates every InvariantMonitor publishing into this process's
+        registry — all zeros / ``ok`` for a standalone server (the
+        server never sees client placement state), and the live
+        cross-client durability picture when clients are colocated (the
+        scenario harness, tests, bench).  A violated invariant turns
+        the whole document 503 (obs/expo.py)."""
+        durability = obs_invariants.summary_from_registry()
         return obs_expo.health_response(
             schema_version=self.db.schema_version(),
             queue_depth=self.queue.pending(),
             connected_clients=self.connections.count(),
-            uptime_s=round(time.time() - self._started, 3))
+            uptime_s=round(time.time() - self._started, 3),
+            durability=durability,
+            status=durability["status"])
 
     async def ws(self, request):
         token = request.headers.get("Authorization")

@@ -16,6 +16,11 @@ reference prints ad-hoc lines and keeps no machine-readable telemetry):
   journal of status events, span closes, retry firings, and fault-plane
   injections, with a panic handler that dumps the metrics snapshot plus
   the last N journal lines;
+* :mod:`~backuwup_tpu.obs.invariants` — the durability invariant
+  monitor: sweeps the verifier-side placement/audit state into live
+  ``bkw_durability_*`` facts (clean survivors per stripe, repair debt,
+  unrestorable packfiles) that /healthz and the scenario scorecard
+  consume;
 * :mod:`~backuwup_tpu.obs.expo` — ``GET /metrics`` + ``GET /healthz``
   exposition shared by the coordination server and the opt-in client
   status port.
@@ -26,6 +31,6 @@ on jax or any accelerator runtime, so every layer can instrument itself
 without import cycles or device initialization.
 """
 
-from . import journal, metrics, trace
+from . import invariants, journal, metrics, trace
 
-__all__ = ["journal", "metrics", "trace"]
+__all__ = ["invariants", "journal", "metrics", "trace"]

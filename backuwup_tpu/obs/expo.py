@@ -35,8 +35,14 @@ def metrics_response() -> web.Response:
 
 def health_response(**fields) -> web.Response:
     """``{"status": "ok", ...fields}`` as JSON (liveness plus whatever
-    cheap facts the mounting process wants to advertise)."""
-    return web.json_response({"status": "ok", **fields})
+    cheap facts the mounting process wants to advertise).  A caller
+    that passes ``status="violated"`` — a broken durability invariant
+    (obs/invariants.py) — gets HTTP 503 so dumb probes flip without
+    parsing the body; ``degraded`` stays 200 (data still restorable,
+    margin shrinking)."""
+    doc = {"status": "ok", **fields}
+    code = 503 if doc.get("status") == "violated" else 200
+    return web.json_response(doc, status=code)
 
 
 class StatusServer:

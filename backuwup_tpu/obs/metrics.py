@@ -54,6 +54,37 @@ def log_buckets(start: float, factor: float, count: int) -> Tuple[float, ...]:
 DEFAULT_SECONDS_BUCKETS = log_buckets(0.001, 2.0, 16)
 
 
+def quantile_from_buckets(bounds: Sequence[float],
+                          counts: Sequence[int], q: float) -> float:
+    """Estimate the ``q``-quantile of a bucketed histogram.
+
+    ``counts`` are *per-bucket* observation counts aligned with
+    ``bounds`` plus one trailing overflow bucket (the internal
+    :class:`Histogram` layout, NOT the cumulative exposition view).
+    Within the located bucket the estimate interpolates geometrically
+    (log-linear), matching the :func:`log_buckets` layout; the first
+    bucket (lower edge 0) interpolates linearly.  Observations past the
+    last bound clamp to it — the honest answer a bounded layout can
+    give.  An empty histogram or an out-of-range ``q`` returns NaN.
+    """
+    total = sum(counts)
+    if total <= 0 or not 0.0 <= q <= 1.0:
+        return math.nan
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts[:-1]):
+        prev = cum
+        cum += c
+        if cum >= rank and c > 0:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = float(bounds[i])
+            frac = (rank - prev) / c
+            if lo > 0.0:
+                return lo * (hi / lo) ** frac
+            return hi * frac
+    return float(bounds[-1])
+
+
 def _fmt(v: float) -> str:
     """Prometheus sample-value formatting (integers without the .0)."""
     if v == math.inf:
@@ -203,6 +234,17 @@ class Histogram(_Family):
         with self._lock:
             state = self._series.get(key)
             return sum(state[0]) if state else 0
+
+    def quantile(self, q: float, **labels) -> float:
+        """Estimated ``q``-quantile for one labeled series (NaN when the
+        series has no observations) — see :func:`quantile_from_buckets`."""
+        key = self._key(labels)
+        with self._lock:
+            state = self._series.get(key)
+            counts = list(state[0]) if state else None
+        if counts is None:
+            return math.nan
+        return quantile_from_buckets(self.bounds, counts, q)
 
     def bucket_counts(self, **labels) -> Dict[str, int]:
         """Cumulative per-``le`` counts (the exposition view)."""

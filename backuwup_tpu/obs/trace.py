@@ -111,7 +111,18 @@ def bind(trace_id: Optional[str]) -> Iterator[None]:
     try:
         yield
     finally:
+        _reset(token)
+
+
+def _reset(token) -> None:
+    # A coroutine closed by GC (e.g. an aborted aiohttp handler) runs its
+    # finally blocks in whatever context the collector happened to be in;
+    # ContextVar.reset then raises "created in a different Context".  The
+    # binding dies with the coroutine either way, so swallow it.
+    try:
         _ctx.reset(token)
+    except ValueError:
+        pass
 
 
 # --- the flat aggregate table (exact utils/tracing.py semantics) ------------
@@ -145,7 +156,7 @@ def span(name: str) -> Iterator[SpanContext]:
         yield ctx
     finally:
         dt = time.perf_counter() - t0
-        _ctx.reset(token)
+        _reset(token)
         if _enabled:
             with _lock:
                 calls, total = _spans.get(name, (0, 0.0))

@@ -1,0 +1,16 @@
+"""Composed chaos scenarios and their scorecard gate.
+
+``harness`` runs a scripted multi-client deployment through timed fault
+phases while sampling the durability invariants; ``scorecard`` turns
+the run's registry deltas and samples into a machine-readable pass/fail
+card.  ``scripts/scenario.py`` is the CLI; the ``scenario``-marked
+tests gate the composed scenario in tier 1.
+"""
+
+from .harness import (Phase, ScenarioHarness, ScenarioSpec,
+                      builtin_scenarios, run_scenario)
+from .scorecard import Assertion, Scorecard, build_scorecard
+
+__all__ = ["Phase", "ScenarioHarness", "ScenarioSpec",
+           "builtin_scenarios", "run_scenario",
+           "Assertion", "Scorecard", "build_scorecard"]

@@ -1,0 +1,530 @@
+"""Composed chaos scenario harness (docs/scenarios.md).
+
+The chaos e2e tests each exercise one subsystem; the ROADMAP
+scenario-matrix item asks for their composition.  This harness runs a
+full loopback deployment — one CoordinationServer, one source client,
+N storage holders, and spare peers, all in-process — and drives it
+through a scripted sequence of timed phases:
+
+=============  ============================================================
+``backup``     full backup of the (optionally grown) corpus; every
+               packfile placed as an RS(k+m) stripe on distinct holders
+``steady``     idle wall time: the invariant sampler keeps sweeping and
+               steady state must stay clean
+``churn``      a backup racing sustained peer churn: holders are killed
+               and revived through the fault plane every ``interval_s``
+               while the transfer plane retries around them
+``byzantine``  holders' stored shard bytes are flipped; one audit round
+               catches the bad proofs and demotes them
+``kill``       unrepaired peer loss: a holder goes permanently dark and
+               is audit-demoted via consecutive misses — durability
+               must flip to degraded within one monitor sweep
+``repair``     one ``engine.repair_round()``: sourceless shard rebuild
+               onto spare peers
+``race``       backup + restore + repair all fired concurrently on the
+               one client; losers of the exclusivity lock spin on
+               EngineError until everything completes
+``restore``    restore to a fresh directory and verify byte-for-byte
+               against the source tree digest
+=============  ============================================================
+
+Everything is seeded (fault plane, corpus bytes, victim choice), so a
+scenario is deterministic enough for a tier-1 test; a background sampler
+sweeps :class:`~backuwup_tpu.obs.invariants.InvariantMonitor`
+continuously and the run ends in a :class:`~.scorecard.Scorecard` built
+from registry deltas with hard pass/fail assertions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import hashlib
+import random
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from .. import defaults
+from ..app import ClientApp
+from ..engine import EngineError
+from ..net.server import CoordinationServer
+from ..obs import invariants as obs_invariants
+from ..obs import metrics as obs_metrics
+from ..ops.backend import ChunkerBackend, CpuBackend
+from ..ops.gear import CDCParams
+from ..utils import faults
+from . import scorecard as sc
+
+
+class ScenarioError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Phase:
+    """One scripted step; ``kind`` selects the behavior table above."""
+
+    kind: str
+    duration_s: float = 0.0  # steady/churn wall time
+    count: int = 1           # victims for byzantine/kill
+    interval_s: float = 0.3  # churn kill/revive cadence
+    grow: bool = False       # write fresh corpus files first
+    name: str = ""
+
+    @property
+    def label(self) -> str:
+        return self.name or self.kind
+
+
+@dataclass(frozen=True)
+class ScenarioSpec:
+    name: str
+    phases: tuple
+    seed: int = 1234
+    holders: int = 6
+    spares: int = 1
+    corpus_files: int = 6
+    corpus_file_bytes: int = 24 * 1024
+    packfile_target: int = 64 * 1024
+    chunk_desired: int = 4096
+    sample_interval_s: float = 0.1
+    expect_violation: bool = False
+    expect_final_status: str = "ok"
+    min_shards_rebuilt: int = 0
+
+
+#: defaults shrunk for loopback scenarios; saved/restored around a run.
+_PATCH = {
+    "ACK_TIMEOUT_S": 1.5,
+    "RESTORE_REQUEST_THROTTLE_S": 0.0,
+    "AUDIT_SERVE_MIN_INTERVAL_S": 0.0,
+    "PEER_WAIT_BASE_S": 0.05,
+    "PEER_WAIT_CAP_S": 0.25,
+    "DIAL_RETRY_ATTEMPTS": 1,
+    "DIAL_RETRY_BASE_S": 0.05,
+    "DIAL_RETRY_CAP_S": 0.2,
+    "DURABILITY_SWEEP_INTERVAL_S": 0.5,
+}
+
+
+def _tree_digest(root: Path) -> Dict[str, str]:
+    out = {}
+    for p in sorted(root.rglob("*")):
+        if p.is_file():
+            out[str(p.relative_to(root))] = hashlib.sha256(
+                p.read_bytes()).hexdigest()
+    return out
+
+
+class ScenarioHarness:
+    """Owns the deployment, the fault plane, and the invariant sampler
+    for one scenario run.  Use :func:`run_scenario` unless a test needs
+    to poke mid-run state (the healthz-flip test does)."""
+
+    def __init__(self, spec: ScenarioSpec, workdir: Path,
+                 backend: Optional[ChunkerBackend] = None):
+        self.spec = spec
+        self.workdir = Path(workdir)
+        self.backend = backend
+        self.rng = random.Random(spec.seed)
+        self.src = self.workdir / "src"
+        self.samples: List[dict] = []
+        self.facts: Dict = {"backups": 0, "restores": 0, "repairs": 0,
+                            "demoted": [], "restore_verified": None,
+                            "source_digest": None}
+        self.server: Optional[CoordinationServer] = None
+        self.a: Optional[ClientApp] = None
+        self.holders: List[ClientApp] = []
+        self.spares: List[ClientApp] = []
+        self.plane: Optional[faults.FaultPlane] = None
+        self.monitor = None
+        self.server_port: Optional[int] = None
+        self.t0 = 0.0
+        self._saved: Dict = {}
+        self._grown = 0
+        self._restores = 0
+
+    # --- lifecycle ---------------------------------------------------------
+
+    async def setup(self) -> None:
+        spec = self.spec
+        self._saved = {k: getattr(defaults, k) for k in _PATCH}
+        self._saved["PACKFILE_TARGET_SIZE"] = defaults.PACKFILE_TARGET_SIZE
+        for k, v in _PATCH.items():
+            setattr(defaults, k, v)
+        defaults.PACKFILE_TARGET_SIZE = spec.packfile_target
+        self.plane = faults.install(faults.FaultPlane(seed=spec.seed))
+        if self.backend is None:
+            self.backend = CpuBackend(
+                CDCParams.from_desired(spec.chunk_desired))
+        self._write_corpus("seed")
+
+        self.server = CoordinationServer(
+            db_path=str(self.workdir / "server.db"))
+        self.server_port = await self.server.start()
+
+        def make_app(name: str) -> ClientApp:
+            app = ClientApp(config_dir=self.workdir / name / "cfg",
+                            data_dir=self.workdir / name / "data",
+                            server_addr=f"127.0.0.1:{self.server_port}",
+                            backend=self.backend,
+                            tls=False)  # plaintext loopback deployment
+            app.store.set_backup_path(str(self.src))
+            return app
+
+        self.a = make_app("a")
+        self.holders = [make_app(f"h{i}") for i in range(spec.holders)]
+        self.spares = [make_app(f"s{i}") for i in range(spec.spares)]
+        for app in self._apps():
+            await app.start()
+            # the harness drives audits and sweeps; background schedulers
+            # would inject nondeterminism
+            app._audit_task.cancel()
+            app._monitor_task.cancel()
+        self.a.engine.auto_repair = False
+        self.monitor = self.a.monitor
+
+        # manual negotiation (matchmaking has its own tests); holders get
+        # the larger allowance so free-space ordering stripes onto them
+        # and spares stay fresh for sourceless repair to re-home onto
+        grants = [(h, 32 << 20) for h in self.holders] + \
+                 [(s, 8 << 20) for s in self.spares]
+        for peer, amount in grants:
+            self.a.store.add_peer_negotiated(peer.client_id, amount)
+            peer.store.add_peer_negotiated(self.a.client_id, amount)
+            self.server.db.save_storage_negotiated(
+                bytes(self.a.client_id), bytes(peer.client_id), amount)
+
+    async def teardown(self) -> None:
+        for app in self._apps():
+            try:
+                await app.stop()
+            except Exception:
+                pass
+        if self.server is not None:
+            await self.server.stop()
+        faults.uninstall()
+        for k, v in self._saved.items():
+            setattr(defaults, k, v)
+
+    def _apps(self) -> List[ClientApp]:
+        return [self.a] + self.holders + self.spares if self.a else []
+
+    # --- the run -----------------------------------------------------------
+
+    async def run(self) -> sc.Scorecard:
+        before = obs_metrics.registry().snapshot()
+        self.t0 = time.time()
+        sampler = asyncio.create_task(self._sampler())
+        error: Optional[tuple] = None
+        executed: List[str] = []
+        try:
+            for phase in self.spec.phases:
+                executed.append(phase.label)
+                try:
+                    await self._run_phase(phase)
+                except Exception as e:
+                    error = (phase.label, repr(e)[:300])
+                    break
+        finally:
+            sampler.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await sampler
+        self._sample_once()  # authoritative final sweep
+        after = obs_metrics.registry().snapshot()
+        assertions = self._assertions(
+            error, sc.counter_deltas(before, after))
+        return sc.build_scorecard(self.spec.name, self.spec.seed,
+                                  time.time() - self.t0, executed,
+                                  before, after, self.samples, assertions)
+
+    async def _run_phase(self, ph: Phase) -> None:
+        fn = getattr(self, f"_phase_{ph.kind}", None)
+        if fn is None:
+            raise ScenarioError(f"unknown phase kind {ph.kind!r}")
+        await fn(ph)
+
+    # --- invariant sampling ------------------------------------------------
+
+    def _sample_once(self) -> None:
+        rep = self.monitor.sweep()
+        self.samples.append({
+            "t": round(time.time() - self.t0, 3),
+            "status": rep.status,
+            "status_level": obs_invariants._STATUS_LEVEL[rep.status],
+            "stripes_total": rep.stripes_total,
+            "stripes_degraded": rep.stripes_degraded,
+            "stripes_lost": rep.stripes_lost,
+            "unrestorable": rep.packfiles_unrestorable,
+            "repair_debt_bytes": rep.repair_debt_bytes,
+            "orphaned_placements": rep.orphaned_placements,
+        })
+
+    async def _sampler(self) -> None:
+        while True:
+            self._sample_once()
+            await asyncio.sleep(self.spec.sample_interval_s)
+
+    # --- corpus ------------------------------------------------------------
+
+    def _write_corpus(self, tag: str) -> None:
+        self.src.mkdir(parents=True, exist_ok=True)
+        for i in range(self.spec.corpus_files):
+            sub = self.src / f"d{i % 2}"
+            sub.mkdir(exist_ok=True)
+            size = self.spec.corpus_file_bytes + self.rng.randrange(4096)
+            (sub / f"{tag}_{i}.bin").write_bytes(self.rng.randbytes(size))
+
+    def _grow(self) -> None:
+        self._grown += 1
+        self._write_corpus(f"grow{self._grown}")
+
+    async def _retry_busy(self, op, pause: float = 0.05):
+        """Spin on the engine exclusivity lock — the race phase's whole
+        point is that concurrent ops are rejected, counted
+        (bkw_engine_busy_rejections_total), and succeed on retry."""
+        while True:
+            try:
+                return await op()
+            except EngineError as e:
+                if "already running" not in str(e):
+                    raise
+                await asyncio.sleep(pause)
+
+    def _alive_holders(self) -> List[ClientApp]:
+        return [h for h in self.holders
+                if not self.plane.is_dead(h.client_id)
+                and not self.a.store.get_audit_state(h.client_id).demoted]
+
+    # --- phases ------------------------------------------------------------
+
+    async def _phase_backup(self, ph: Phase) -> None:
+        if ph.grow:
+            self._grow()
+        snapshot = await asyncio.wait_for(self.a.backup(), 180)
+        if not snapshot:
+            raise ScenarioError("backup returned no snapshot")
+        self.facts["backups"] += 1
+        self.facts["source_digest"] = _tree_digest(self.src)
+
+    async def _phase_steady(self, ph: Phase) -> None:
+        await asyncio.sleep(ph.duration_s)
+
+    async def _phase_churn(self, ph: Phase) -> None:
+        """A backup forced to make progress through sustained peer churn:
+        one holder is down at any moment, the victim rotating every
+        ``interval_s``; the transfer plane must retry around the hole."""
+        if ph.grow:
+            self._grow()
+        backup = asyncio.create_task(self.a.backup())
+        deadline = time.time() + ph.duration_s
+        try:
+            while time.time() < deadline and not backup.done():
+                victim = self.holders[self.rng.randrange(len(self.holders))]
+                self.plane.kill(victim.client_id)
+                await asyncio.sleep(ph.interval_s)
+                self.plane.revive(victim.client_id)
+                await asyncio.sleep(ph.interval_s / 3)
+        finally:
+            for h in self.holders:  # nobody stays dead past the phase
+                self.plane.revive(h.client_id)
+        snapshot = await asyncio.wait_for(backup, 180)
+        if not snapshot:
+            raise ScenarioError("churn backup returned no snapshot")
+        self.facts["backups"] += 1
+        self.facts["source_digest"] = _tree_digest(self.src)
+
+    async def _phase_byzantine(self, ph: Phase) -> None:
+        """Byzantine holders: every stored shard byte-flipped, so their
+        next audit proof is provably wrong and one failed round demotes
+        (AUDIT_DEMOTE_FAILURES)."""
+        victims = self._alive_holders()[:ph.count]
+        if len(victims) < ph.count:
+            raise ScenarioError("not enough alive holders to corrupt")
+        for victim in victims:
+            stored = victim.store.received_dir(self.a.client_id)
+            flipped = 0
+            for f in sorted(stored.rglob("*")):
+                if f.is_file():
+                    blob = bytearray(f.read_bytes())
+                    if blob:
+                        blob[len(blob) // 2] ^= 0xFF
+                        f.write_bytes(bytes(blob))
+                        flipped += 1
+            if not flipped:
+                raise ScenarioError(
+                    f"byzantine victim {victim.client_id.hex()[:8]}"
+                    " holds nothing to corrupt")
+            result = await asyncio.wait_for(
+                self._retry_busy(
+                    lambda v=victim: self.a.engine.audit_peer(v.client_id)),
+                60)
+            if result is None or result.passed:
+                raise ScenarioError("corrupt shards passed their audit")
+            if not self.a.store.get_audit_state(victim.client_id).demoted:
+                raise ScenarioError("failed audit did not demote")
+            self.facts["demoted"].append(victim.client_id.hex()[:8])
+
+    async def _phase_kill(self, ph: Phase) -> None:
+        """Unrepaired peer loss: permanently dark, demoted via
+        consecutive audit misses.  No repair here — the point is that
+        the monitor flips durability to degraded and holds it there."""
+        victims = self._alive_holders()[:ph.count]
+        if len(victims) < ph.count:
+            raise ScenarioError("not enough alive holders to kill")
+        t0 = time.time()
+        for victim in victims:
+            self.plane.kill(victim.client_id)
+            for i in range(defaults.AUDIT_DEMOTE_MISSES):
+                await asyncio.wait_for(
+                    self._retry_busy(
+                        lambda v=victim, i=i: self.a.engine.audit_peer(
+                            v.client_id, now=t0 + i)),
+                    60)
+            if not self.a.store.get_audit_state(victim.client_id).demoted:
+                raise ScenarioError("missed audits did not demote")
+            self.facts["demoted"].append(victim.client_id.hex()[:8])
+
+    async def _phase_repair(self, ph: Phase) -> None:
+        report = await asyncio.wait_for(
+            self._retry_busy(lambda: self.a.engine.repair_round()), 180)
+        self.facts["repairs"] += 1
+        self.facts.setdefault("repair_reports", []).append(
+            {k: report[k] for k in ("packfiles", "bytes_replaced",
+                                    "shards_rebuilt")})
+
+    async def _phase_race(self, ph: Phase) -> None:
+        """backup + restore + repair all at once on one client.  The
+        engine's exclusivity lock serializes them; every loser is
+        rejected (counted) and retries until it runs."""
+        if ph.grow:
+            self._grow()
+        self._restores += 1
+        dest = self.workdir / f"race_restore_{self._restores}"
+        await asyncio.wait_for(asyncio.gather(
+            self._retry_busy(lambda: self.a.backup()),
+            self._retry_busy(lambda: self.a.engine.run_restore(dest)),
+            self._retry_busy(lambda: self.a.engine.repair_round()),
+        ), 240)
+        self.facts["backups"] += 1
+        self.facts["restores"] += 1
+        self.facts["repairs"] += 1
+        self.facts["source_digest"] = _tree_digest(self.src)
+
+    async def _phase_restore(self, ph: Phase) -> None:
+        self._restores += 1
+        dest = self.workdir / f"restore_{self._restores}"
+        await asyncio.wait_for(
+            self._retry_busy(lambda: self.a.restore(dest)), 180)
+        self.facts["restores"] += 1
+        ok = _tree_digest(dest) == self.facts["source_digest"]
+        if self.facts["restore_verified"] is None:
+            self.facts["restore_verified"] = ok
+        else:
+            self.facts["restore_verified"] &= ok
+
+    # --- gates -------------------------------------------------------------
+
+    def _assertions(self, error, counters) -> List[sc.Assertion]:
+        spec, facts = self.spec, self.facts
+        A = sc.Assertion
+        out = [A("phases_completed", error is None,
+                 "" if error is None else f"{error[0]}: {error[1]}")]
+        want_backups = sum(1 for p in spec.phases
+                           if p.kind in ("backup", "churn", "race"))
+        out.append(A("backups_completed",
+                     facts["backups"] >= want_backups,
+                     f"{facts['backups']}/{want_backups}"))
+        if any(p.kind == "restore" for p in spec.phases):
+            out.append(A("restore_verified",
+                         facts["restore_verified"] is True,
+                         "byte-for-byte vs source digest"))
+        violation_s = sum(
+            v for k, v in counters.items()
+            if k.startswith("bkw_durability_violation_seconds_total"))
+        saw_violation = violation_s > 0 or any(
+            s.get("status_level", 0) >= 2 for s in self.samples)
+        if spec.expect_violation:
+            out.append(A("violation_observed", saw_violation,
+                         f"violation_seconds={violation_s:.3f}"))
+        else:
+            out.append(A("zero_violation_seconds", not saw_violation,
+                         f"violation_seconds={violation_s:.3f}"))
+        final = self.monitor.last_report
+        out.append(A("final_status",
+                     final is not None
+                     and final.status == spec.expect_final_status,
+                     f"want {spec.expect_final_status}, got "
+                     f"{final.status if final else 'no sweep'}"))
+        if spec.min_shards_rebuilt:
+            rebuilt = counters.get("bkw_repair_shards_rebuilt_total", 0)
+            out.append(A("shards_rebuilt",
+                         rebuilt >= spec.min_shards_rebuilt,
+                         f"{rebuilt:g} >= {spec.min_shards_rebuilt}"))
+        return out
+
+
+async def run_scenario(spec: ScenarioSpec, workdir,
+                       backend: Optional[ChunkerBackend] = None
+                       ) -> sc.Scorecard:
+    """setup -> run -> teardown; the one-call entry point used by the
+    CLI (scripts/scenario.py), bench config 9, and the tests."""
+    harness = ScenarioHarness(spec, Path(workdir), backend=backend)
+    await harness.setup()
+    try:
+        return await harness.run()
+    finally:
+        await harness.teardown()
+
+
+def builtin_scenarios() -> Dict[str, ScenarioSpec]:
+    """The scenario matrix.  ``composed`` is the tier-1 acceptance run
+    (churn + byzantine + race, < 60 s on loopback); ``full`` is the slow
+    matrix adding unrepaired loss, a second repair wave, and a bigger
+    corpus."""
+    P = Phase
+    return {
+        "steady": ScenarioSpec(
+            name="steady", seed=11,
+            phases=(P("backup"), P("steady", duration_s=0.6),
+                    P("restore"))),
+        "churn": ScenarioSpec(
+            name="churn", seed=21,
+            phases=(P("backup"),
+                    P("churn", duration_s=2.0, interval_s=0.3, grow=True),
+                    # a churn backup may finish with a stripe short a
+                    # shard (kept locally unsent); repair drains the debt
+                    P("repair"),
+                    P("restore"))),
+        "byzantine": ScenarioSpec(
+            name="byzantine", seed=31, min_shards_rebuilt=1,
+            phases=(P("backup"), P("byzantine"), P("repair"),
+                    P("restore"))),
+        "loss": ScenarioSpec(
+            name="loss", seed=41, expect_final_status="degraded",
+            phases=(P("backup"), P("kill"), P("steady", duration_s=0.4))),
+        "composed": ScenarioSpec(
+            name="composed", seed=51, spares=2, min_shards_rebuilt=1,
+            phases=(P("backup"),
+                    P("steady", duration_s=0.4),
+                    P("churn", duration_s=1.5, interval_s=0.3, grow=True),
+                    P("byzantine"),
+                    P("repair"),
+                    P("race", grow=True),
+                    P("restore"))),
+        "full": ScenarioSpec(
+            name="full", seed=61, spares=2, corpus_files=10,
+            corpus_file_bytes=48 * 1024, min_shards_rebuilt=1,
+            phases=(P("backup"),
+                    P("steady", duration_s=1.0),
+                    P("churn", duration_s=4.0, interval_s=0.4, grow=True),
+                    P("byzantine"),
+                    P("repair"),
+                    P("race", grow=True),
+                    P("kill"),
+                    P("steady", duration_s=0.6),
+                    P("repair"),
+                    P("restore"))),
+    }

@@ -353,6 +353,17 @@ class Store:
             self._db.commit()
         return cur.rowcount
 
+    def all_placements(self) -> list:
+        """Every placement row as ``(packfile_id, peer, size,
+        shard_index, sent_at)`` — the invariant monitor's one-query
+        sweep over the who-holds-what map (obs/invariants.py)."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT packfile_id, peer, size, shard_index, sent_at"
+                " FROM placements").fetchall()
+        return [(bytes(r[0]), bytes(r[1]), int(r[2]), int(r[3]),
+                 float(r[4])) for r in rows]
+
     def peers_with_placements(self) -> list:
         with self._lock:
             rows = self._db.execute(

@@ -219,6 +219,13 @@ def main() -> None:
     transfer = configs.get("8_transfer", {})
     if "speedup" in transfer:
         record["transfer_speedup"] = transfer["speedup"]
+    # config #9 is pass/fail: surface the scorecard verdict at top level
+    # so a durability regression is one grep away in BENCH_r*.json
+    scenario = configs.get("9_scenario", {})
+    if "passed" in scenario:
+        record["scenario_passed"] = scenario["passed"]
+        record["scenario_violation_seconds"] = \
+            scenario.get("violation_seconds", 0)
     print(json.dumps({
         **record,
         "note": "corpus synthesized on-device (host<->device relay tunnel "

@@ -719,6 +719,41 @@ def config8_transfer(log: Callable) -> Dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def config9_scenario(log: Callable) -> Dict:
+    """Composed chaos scenario + scorecard gate — config #9.
+
+    Runs the seeded ``composed`` scenario (scenario/harness.py: backup,
+    sustained churn, byzantine corrupt-shard audit demotion, sourceless
+    repair, backup + restore + repair racing the exclusivity lock) and
+    embeds the full scorecard in the BENCH record, so every bench run
+    doubles as a durability regression gate: ``passed`` flips false if
+    any hard assertion (zero invariant-violation-seconds, verified
+    restore, shards rebuilt, final status ok) regresses.
+    """
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    from backuwup_tpu.scenario import builtin_scenarios, run_scenario
+
+    spec = builtin_scenarios()["composed"]
+    with tempfile.TemporaryDirectory(prefix="bkw_bench_scenario_") as td:
+        card = asyncio.run(run_scenario(spec, Path(td)))
+    counters = card.counters
+    rebuilt = counters.get("bkw_repair_shards_rebuilt_total", 0)
+    log(f"config#9 scenario '{card.scenario}' (seed {card.seed}): "
+        f"{'PASS' if card.passed else 'FAIL'} in {card.elapsed_s:.1f}s, "
+        f"violation_s={card.invariants['violation_seconds']}, "
+        f"shards_rebuilt={rebuilt:g}, "
+        f"final={card.invariants['final'].get('status', '?')}")
+    return {"passed": card.passed,
+            "violation_seconds": card.invariants["violation_seconds"],
+            "worst_status": card.invariants["worst_status"],
+            "shards_rebuilt": int(rebuilt),
+            "wall_s": round(card.elapsed_s, 2),
+            "scorecard": card.to_dict()}
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -731,7 +766,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("5_cross_peer_dedup", lambda: config5_cross_peer(log)),
             ("6_end_to_end", lambda: config6_end_to_end(log)),
             ("7_erasure", lambda: config7_erasure(log)),
-            ("8_transfer", lambda: config8_transfer(log))):
+            ("8_transfer", lambda: config8_transfer(log)),
+            ("9_scenario", lambda: config9_scenario(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

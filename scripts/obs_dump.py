@@ -5,7 +5,9 @@ Three read-only views, no accelerator and no repo imports beyond stdlib:
 
 * ``--url http://HOST:PORT`` — fetch ``/metrics`` from a coordination
   server or a client status listener (BKW_STATUS_PORT) and print the
-  non-zero samples, one per line.
+  non-zero samples, one per line, followed by estimated p50/p99 lines
+  for each histogram series.  ``--watch N`` re-polls every N seconds
+  and prints only the samples that changed, with their deltas.
 * ``--journal PATH [-n N]`` — tail the last N parsed lines of a JSONL
   journal written under ``BKW_JOURNAL``; ``--trace TID`` filters to one
   correlated trace.
@@ -17,30 +19,125 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
+import re
 import sys
+import time
 import urllib.request
 
+_BUCKET_RE = re.compile(r'^(?P<name>[A-Za-z_:][\w:]*)_bucket'
+                        r'\{(?P<labels>[^}]*)\} ')
+_LE_RE = re.compile(r'(^|,)le="(?P<le>[^"]+)"')
 
-def dump_metrics(url: str, raw: bool) -> int:
+
+def _fetch(url: str) -> str:
     if not url.rstrip("/").endswith("/metrics"):
         url = url.rstrip("/") + "/metrics"
     with urllib.request.urlopen(url, timeout=10) as resp:
-        text = resp.read().decode("utf-8", "replace")
-    if raw:
-        sys.stdout.write(text)
-        return 0
+        return resp.read().decode("utf-8", "replace")
+
+
+def _parse(text: str) -> "dict[str, float]":
+    """Exposition text -> {sample key: value}, skipping comments."""
+    out = {}
     for line in text.splitlines():
         if line.startswith("#") or not line.strip():
             continue
-        # keep the catalog readable: hide never-touched zero samples
-        # (bucket cumulative zeros, un-fired counters)
         try:
-            value = float(line.rsplit(" ", 1)[1])
+            key, value = line.rsplit(" ", 1)
+            out[key] = float(value)
         except (IndexError, ValueError):
-            value = 1.0
-        if value != 0.0:
-            print(line)
+            continue
+    return out
+
+
+def _quantile(bounds, counts, q):
+    """Log-bucket quantile estimate — same geometric interpolation as
+    backuwup_tpu.obs.metrics.quantile_from_buckets, restated here so the
+    script stays stdlib-only."""
+    total = sum(counts)
+    if total <= 0:
+        return math.nan
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts[:-1]):
+        prev = cum
+        cum += c
+        if cum >= rank and c > 0:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = float(bounds[i])
+            frac = (rank - prev) / c
+            if lo > 0.0:
+                return lo * (hi / lo) ** frac
+            return hi * frac
+    return float(bounds[-1])
+
+
+def _histogram_quantiles(samples: dict, prev=None) -> "list[str]":
+    """One ``p50/p99`` line per histogram series; with ``prev``, over
+    the delta of the cumulative bucket counts (this interval only)."""
+    series = {}
+    for key, value in samples.items():
+        m = _BUCKET_RE.match(key + " ")
+        if not m:
+            continue
+        le = _LE_RE.search(m.group("labels"))
+        if not le:
+            continue
+        base = _LE_RE.sub("", m.group("labels")).strip(",")
+        if prev is not None:
+            value -= prev.get(key, 0.0)
+        series.setdefault((m.group("name"), base),
+                          {})[le.group("le")] = value
+    lines = []
+    for (name, base), buckets in sorted(series.items()):
+        keys = sorted((k for k in buckets if k != "+Inf"), key=float)
+        bounds = [float(k) for k in keys]
+        counts, cum_prev = [], 0.0
+        for k in keys:
+            counts.append(buckets[k] - cum_prev)
+            cum_prev = buckets[k]
+        counts.append(buckets.get("+Inf", cum_prev) - cum_prev)
+        total = int(sum(counts))
+        if total <= 0 or not bounds:
+            continue
+        p50 = _quantile(bounds, counts, 0.5)
+        p99 = _quantile(bounds, counts, 0.99)
+        tag = f"{name}{{{base}}}" if base else name
+        lines.append(f"~ {tag} p50={p50:.6g} p99={p99:.6g} n={total}")
+    return lines
+
+
+def _print_view(samples: dict, prev=None) -> None:
+    """Non-zero samples (first poll) or changed-with-delta (re-polls),
+    then the histogram quantile summary lines."""
+    for key, value in samples.items():
+        if prev is None:
+            # keep the catalog readable: hide never-touched zero samples
+            # (bucket cumulative zeros, un-fired counters)
+            if value != 0.0:
+                print(f"{key} {value:g}")
+        else:
+            delta = value - prev.get(key, 0.0)
+            if delta != 0.0:
+                print(f"{key} {value:g} ({delta:+g})")
+    for line in _histogram_quantiles(samples, prev=prev):
+        print(line)
+
+
+def dump_metrics(url: str, raw: bool, watch: float) -> int:
+    samples = _parse(_fetch(url))
+    if raw and not watch:
+        sys.stdout.write(_fetch(url))
+        return 0
+    _print_view(samples)
+    while watch:
+        time.sleep(watch)
+        fresh = _parse(_fetch(url))
+        print(f"--- {time.strftime('%H:%M:%S')} (+{watch:g}s)")
+        _print_view(fresh, prev=samples)
+        samples = fresh
     return 0
 
 
@@ -81,9 +178,15 @@ def main(argv=None) -> int:
                     help="only journal lines with this trace_id")
     ap.add_argument("--raw", action="store_true",
                     help="with --url: full exposition incl. zero samples")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="N",
+                    help="with --url: re-poll every N seconds and print "
+                         "changed samples with deltas (ctrl-c to stop)")
     args = ap.parse_args(argv)
     if args.url:
-        return dump_metrics(args.url, args.raw)
+        try:
+            return dump_metrics(args.url, args.raw, args.watch)
+        except KeyboardInterrupt:
+            return 0
     if args.journal:
         return dump_journal(args.journal, args.lines, args.trace)
     return dump_panic(args.panic)

@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""Run a composed chaos scenario and print its scorecard.
+
+Examples::
+
+    python scripts/scenario.py --list
+    python scripts/scenario.py --scenario composed
+    python scripts/scenario.py --scenario full --seed 7 \\
+        --out card.json --samples samples.jsonl
+
+Exit status is 0 when every scorecard assertion passed, 1 otherwise —
+usable directly as a CI gate.  See docs/scenarios.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from backuwup_tpu.scenario import builtin_scenarios, run_scenario  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", default="composed",
+                    help="scenario name (see --list)")
+    ap.add_argument("--list", action="store_true",
+                    help="list built-in scenarios and exit")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override the scenario's seed")
+    ap.add_argument("--out", default=None,
+                    help="write the scorecard JSON here")
+    ap.add_argument("--samples", default=None,
+                    help="write the raw invariant samples (JSONL) here")
+    ap.add_argument("--workdir", default=None,
+                    help="run here instead of a throwaway temp dir")
+    args = ap.parse_args()
+
+    scenarios = builtin_scenarios()
+    if args.list:
+        for name, spec in scenarios.items():
+            print(f"{name:10s} seed={spec.seed:<4d} "
+                  f"phases={'/'.join(p.label for p in spec.phases)}")
+        return 0
+    spec = scenarios.get(args.scenario)
+    if spec is None:
+        print(f"unknown scenario {args.scenario!r}; try --list",
+              file=sys.stderr)
+        return 2
+    if args.seed is not None:
+        spec = dataclasses.replace(spec, seed=args.seed)
+
+    def run_in(workdir: Path):
+        return asyncio.run(run_scenario(spec, workdir))
+
+    if args.workdir:
+        workdir = Path(args.workdir)
+        workdir.mkdir(parents=True, exist_ok=True)
+        card = run_in(workdir)
+    else:
+        with tempfile.TemporaryDirectory(prefix="bkw_scenario_") as td:
+            card = run_in(Path(td))
+
+    print(card.render())
+    if args.out:
+        card.write_json(args.out)
+        print(f"scorecard written to {args.out}")
+    if args.samples:
+        card.write_samples_jsonl(args.samples)
+        print(f"samples written to {args.samples}")
+    return 0 if card.passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

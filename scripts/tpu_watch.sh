@@ -39,6 +39,17 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "transfer bench recapture FAILED (see $trf) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated scenario recapture: config #9 alone (host-only
+        # composed chaos scenario + scorecard) — the durability gate
+        # verdict survives even when the device suite timed out partway
+        scn="$BENCH_OUT_DIR/BENCH_scenario_${stamp}.json"
+        if timeout "${BENCH_SCENARIO_TIMEOUT_S:-600}" \
+                env BENCH_ONLY_CONFIG=9_scenario BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$scn" 2>>/tmp/tpu_watch.log; then
+            echo "scenario bench recaptured to $scn at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "scenario bench recapture FAILED (see $scn) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

@@ -58,6 +58,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "concurrency: deterministic transfer-plane overlap"
         " tests (fault-plane latency/death injection); tier-1 safe")
+    config.addinivalue_line(
+        "markers", "scenario: composed chaos scenario runs"
+        " (scenario/harness.py); the fast seeded ones are tier-1, the"
+        " full matrix is also marked slow")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,262 @@
+"""Durability invariant monitor (obs/invariants.py).
+
+The monitor's one job is to state whether the restore promise holds
+RIGHT NOW from verifier-side state alone, so these tests build placement
+tables by hand and check every classification edge: empty store, legacy
+whole-file + striped mixes, exactly RS_K clean survivors (degraded, not
+violated) vs RS_K - 1 with lost rows (violated), mid-upload incomplete
+stripes (degraded, never violated), the dark-peer deadline boundary,
+violation-second accrual, orphan detection against the blob index, and
+the cross-client registry summary the server /healthz reports.
+
+Plus the histogram quantile estimator the scorecard and obs_dump use.
+"""
+
+import math
+from dataclasses import replace
+
+import pytest
+
+from backuwup_tpu import defaults
+from backuwup_tpu.obs import invariants as inv
+from backuwup_tpu.obs import journal as obs_journal
+from backuwup_tpu.obs import metrics as obs_metrics
+from backuwup_tpu.obs.invariants import InvariantMonitor
+from backuwup_tpu.obs.metrics import log_buckets, quantile_from_buckets
+from backuwup_tpu.store import Store
+
+K, M = defaults.RS_K, defaults.RS_M
+N = K + M
+NOW = 1_700_000_000.0
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """Zero the process registry and drop any installed journal so tests
+    never see each other's durability series."""
+    obs_metrics.registry().reset()
+    yield
+    obs_metrics.registry().reset()
+    obs_journal.uninstall()
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = Store(tmp_path / "cfg", data_base=tmp_path / "data")
+    yield s
+    s.close()
+
+
+def peer(i: int) -> bytes:
+    return bytes([0x50 + i]) * 32
+
+
+def place_stripe(store, pid: bytes, holders, size=4096, now=NOW):
+    for idx, p in enumerate(holders):
+        store.record_placement(pid, p, size, now=now, shard_index=idx)
+
+
+def demote(store, p: bytes) -> None:
+    store.put_audit_state(replace(store.get_audit_state(p), demoted=True))
+
+
+# --- sweep classification ---------------------------------------------------
+
+
+def test_empty_store_sweeps_ok(store):
+    rep = InvariantMonitor(store, client="t").sweep(now=NOW)
+    assert rep.status == "ok"
+    assert rep.stripes_total == 0 and rep.packfiles_total == 0
+    assert rep.repair_debt_bytes == 0 and rep.violations == []
+
+
+def test_clean_mixed_whole_and_striped(store):
+    holders = [peer(i) for i in range(N)]
+    for p in holders:
+        store.add_peer_negotiated(p, 1 << 20, now=NOW)
+    place_stripe(store, b"\x01" * 32, holders, now=NOW)
+    store.record_placement(b"\x02" * 32, holders[0], 9000, now=NOW)  # whole
+    rep = InvariantMonitor(store, client="t").sweep(now=NOW)
+    assert rep.status == "ok"
+    assert rep.stripes_total == 1
+    assert rep.packfiles_total == 2
+    assert rep.placements_total == N + 1
+
+
+def test_exactly_k_clean_survivors_is_degraded_not_violated(store):
+    holders = [peer(i) for i in range(N)]
+    place_stripe(store, b"\x01" * 32, holders, size=1000, now=NOW)
+    for p in holders[:M]:  # lose m -> exactly k clean survive
+        demote(store, p)
+    rep = InvariantMonitor(store, client="t").sweep(now=NOW)
+    assert rep.status == "degraded"
+    assert rep.stripes_degraded == 1 and rep.stripes_lost == 0
+    assert rep.packfiles_unrestorable == 0
+    assert rep.repair_debt_bytes == M * 1000
+    assert any("lost shard(s)" in d for d in rep.degradations)
+
+
+def test_below_k_clean_survivors_is_violated(store):
+    holders = [peer(i) for i in range(N)]
+    place_stripe(store, b"\x01" * 32, holders, now=NOW)
+    for p in holders[:M + 1]:  # k - 1 clean left
+        demote(store, p)
+    rep = InvariantMonitor(store, client="t").sweep(now=NOW)
+    assert rep.status == "violated"
+    assert rep.stripes_lost == 1 and rep.packfiles_unrestorable == 1
+    assert any("unrestorable" in v for v in rep.violations)
+
+
+def test_incomplete_stripe_without_loss_never_violates(store):
+    # placements land per-ack, so a mid-upload stripe is short rows with
+    # nobody lost: that is shrinking margin, not a broken promise
+    holders = [peer(i) for i in range(K - 1)]  # fewer than k rows
+    place_stripe(store, b"\x01" * 32, holders, now=NOW)
+    rep = InvariantMonitor(store, client="t").sweep(now=NOW)
+    assert rep.status == "degraded"
+    assert rep.stripes_lost == 0 and rep.packfiles_unrestorable == 0
+    assert any("incomplete" in d for d in rep.degradations)
+
+
+def test_live_whole_replica_trumps_stripe_math(store):
+    holders = [peer(i) for i in range(N)]
+    pid = b"\x01" * 32
+    place_stripe(store, pid, holders, now=NOW)
+    store.record_placement(pid, peer(10), 9000, now=NOW)  # whole copy
+    for p in holders[:N]:  # every shard lost...
+        demote(store, p)
+    rep = InvariantMonitor(store, client="t").sweep(now=NOW)
+    # ...but the whole replica keeps it restorable: degraded (debt), not
+    # violated
+    assert rep.status == "degraded"
+    assert rep.packfiles_unrestorable == 0
+
+
+def test_whole_packfile_with_every_replica_lost_is_violated(store):
+    store.record_placement(b"\x02" * 32, peer(0), 9000, now=NOW)
+    demote(store, peer(0))
+    rep = InvariantMonitor(store, client="t").sweep(now=NOW)
+    assert rep.status == "violated"
+    assert rep.packfiles_unrestorable == 1
+    assert any("every replica" in v for v in rep.violations)
+
+
+def test_dark_peer_deadline_boundary(store):
+    deadline = defaults.PEER_DARK_DEADLINE_S
+    store.record_placement(b"\x02" * 32, peer(0), 9000, now=NOW)
+    # last_seen exactly at the deadline: NOT lost (strictly past it is)
+    store.add_peer_negotiated(peer(0), 1 << 20, now=NOW - deadline)
+    assert inv.lost_peers(store, NOW) == set()
+    rep = InvariantMonitor(store, client="t").sweep(now=NOW)
+    assert rep.status == "ok"
+    # one second past the deadline: lost, and the whole-file placement
+    # flips straight to violated
+    rep = InvariantMonitor(store, client="t").sweep(now=NOW + 1.0)
+    assert inv.lost_peers(store, NOW + 1.0) == {peer(0)}
+    assert rep.status == "violated"
+
+
+def test_violation_seconds_accrue_from_previous_bad_sweep(store):
+    store.record_placement(b"\x02" * 32, peer(0), 9000, now=NOW)
+    demote(store, peer(0))
+    mon = InvariantMonitor(store, client="t")
+
+    def violation_s():
+        snap = obs_metrics.registry().snapshot()
+        fam = snap.get("bkw_durability_violation_seconds_total")
+        return sum(s["value"] for s in fam["series"]) if fam else 0.0
+
+    mon.sweep(now=NOW)        # first bad sweep starts the clock
+    assert violation_s() == 0.0
+    mon.sweep(now=NOW + 5.0)  # still violated: the interval accrues
+    assert violation_s() == pytest.approx(5.0)
+    mon.sweep(now=NOW + 7.5)
+    assert violation_s() == pytest.approx(7.5)
+
+
+def test_orphaned_placements_against_blob_index(store):
+    class FakeIndex:
+        def packfile_ids(self):
+            return {b"\x01" * 32}
+
+    holders = [peer(i) for i in range(N)]
+    place_stripe(store, b"\x01" * 32, holders, now=NOW)   # referenced
+    place_stripe(store, b"\x09" * 32, holders, now=NOW)   # leaked
+    rep = InvariantMonitor(store, index=FakeIndex(),
+                           client="t").sweep(now=NOW)
+    assert rep.orphaned_placements == N
+    assert rep.status == "degraded"
+    assert any("orphaned" in d for d in rep.degradations)
+
+
+def test_audit_coverage_age_from_placement_then_ledger(store):
+    max_age = defaults.DURABILITY_AUDIT_MAX_AGE_S
+    holders = [peer(i) for i in range(N)]
+    place_stripe(store, b"\x01" * 32, holders, now=NOW - max_age - 60)
+    # never audited: age counts from first placement and is past the cap
+    rep = InvariantMonitor(store, client="t").sweep(now=NOW)
+    assert rep.audit_coverage_age_s == pytest.approx(max_age + 60)
+    assert any("stalest audit" in d for d in rep.degradations)
+    # a fresh attestation for every holder resets the age
+    for p in holders:
+        store.put_audit_state(replace(store.get_audit_state(p),
+                                      last_audit=NOW - 1.0))
+    rep = InvariantMonitor(store, client="t").sweep(now=NOW)
+    assert rep.audit_coverage_age_s == pytest.approx(1.0)
+    assert rep.status == "ok"
+
+
+def test_summary_from_registry_sums_clients_and_takes_worst_status(
+        store, tmp_path):
+    other = Store(tmp_path / "cfg2", data_base=tmp_path / "data2")
+    try:
+        holders = [peer(i) for i in range(N)]
+        place_stripe(store, b"\x01" * 32, holders, now=NOW)
+        place_stripe(other, b"\x02" * 32, holders, now=NOW)
+        for p in holders[:M]:
+            demote(other, p)
+        InvariantMonitor(store, client="a").sweep(now=NOW)
+        InvariantMonitor(other, client="b").sweep(now=NOW)
+        summary = inv.summary_from_registry()
+        assert summary["stripes_total"] == 2     # summed across clients
+        assert summary["stripes_degraded"] == 1  # only client b's
+        assert summary["status"] == "degraded"   # the worst of ok/degraded
+    finally:
+        other.close()
+
+
+def test_fresh_registry_summary_is_ok_zeros():
+    summary = inv.summary_from_registry()
+    assert summary["status"] == "ok"
+    assert summary["stripes_total"] == 0
+
+
+# --- histogram quantile estimation (scorecard + obs_dump) -------------------
+
+
+def test_quantile_from_buckets_empty_is_nan():
+    assert math.isnan(quantile_from_buckets([0.1, 1.0], [0, 0, 0], 0.5))
+
+
+def test_quantile_from_buckets_interpolates_geometrically():
+    bounds = [1.0, 2.0]
+    # all mass in the (1, 2] bucket: p50 sits at the geometric midpoint
+    assert quantile_from_buckets(bounds, [0, 10, 0], 0.5) == \
+        pytest.approx(math.sqrt(2.0))
+    # first bucket has no lower edge: linear within (0, 1]
+    assert quantile_from_buckets(bounds, [10, 0, 0], 0.5) == \
+        pytest.approx(0.5)
+
+
+def test_quantile_from_buckets_overflow_clamps_to_last_bound():
+    assert quantile_from_buckets([1.0, 2.0], [0, 0, 7], 0.99) == 2.0
+
+
+def test_histogram_quantile_method_per_series():
+    h = obs_metrics.histogram("t_q_seconds", "t", ("op",),
+                              buckets=log_buckets(0.001, 2.0, 12))
+    for _ in range(100):
+        h.observe(0.5, op="x")
+    p50 = h.quantile(0.5, op="x")
+    assert 0.25 <= p50 <= 1.0      # within the 0.5-containing bucket
+    assert math.isnan(h.quantile(0.5, op="missing"))

@@ -1,0 +1,96 @@
+"""Composed chaos scenarios and the scorecard gate (scenario/).
+
+Tier 1 runs the seeded ``composed`` scenario — sustained churn,
+byzantine corrupt-shard peers, sourceless repair, and backup + restore +
+repair racing the engine's exclusivity lock — and requires the scorecard
+to pass with zero invariant-violation-seconds.  A second fast test
+proves the acceptance flip: an injected UNREPAIRED peer loss must move
+``bkw_durability_stripes_degraded`` and the server ``/healthz`` to
+degraded within one monitor sweep.  The rest of the matrix is slow.
+"""
+
+import asyncio
+
+import pytest
+
+from backuwup_tpu.obs import journal as obs_journal
+from backuwup_tpu.obs import metrics as obs_metrics
+from backuwup_tpu.scenario import (Phase, ScenarioHarness,
+                                   builtin_scenarios, run_scenario)
+
+pytestmark = pytest.mark.scenario
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """Zero the process registry and drop any installed journal so one
+    scenario's durability gauges never leak into the next test's
+    healthz."""
+    obs_metrics.registry().reset()
+    yield
+    obs_metrics.registry().reset()
+    obs_journal.uninstall()
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def test_composed_scenario_passes_the_scorecard_gate(tmp_path, loop):
+    card = loop.run_until_complete(
+        run_scenario(builtin_scenarios()["composed"], tmp_path))
+    assert card.passed, card.render()
+    # steady state held: no second with a durability invariant violated
+    assert card.invariants["violation_seconds"] == 0
+    # the byzantine demotion really forced sourceless shard rebuilds
+    assert card.counters.get("bkw_repair_shards_rebuilt_total", 0) >= 1
+    # and the race phase really raced: the exclusivity lock turned
+    # concurrent attempts away before they eventually ran
+    assert any(k.startswith("bkw_engine_busy_rejections_total")
+               for k in card.counters), card.counters
+    assert card.invariants["final"]["status"] == "ok"
+
+
+def test_unrepaired_loss_flips_gauge_and_healthz_in_one_sweep(
+        tmp_path, loop):
+    import aiohttp
+
+    spec = builtin_scenarios()["loss"]
+
+    async def run():
+        h = ScenarioHarness(spec, tmp_path)
+        await h.setup()
+        try:
+            await h._phase_backup(Phase("backup"))
+            assert h.monitor.sweep().status == "ok"
+            await h._phase_kill(Phase("kill"))  # dark + demoted, NO repair
+            rep = h.monitor.sweep()  # the one sweep the flip is due in
+            assert rep.status == "degraded"
+            assert rep.stripes_degraded > 0
+            assert rep.repair_debt_bytes > 0
+            snap = obs_metrics.registry().snapshot()
+            fam = snap["bkw_durability_stripes_degraded"]
+            assert sum(s["value"] for s in fam["series"]) > 0
+            async with aiohttp.ClientSession() as http:
+                url = f"http://127.0.0.1:{h.server_port}/healthz"
+                async with http.get(url) as resp:
+                    doc = await resp.json()
+            # degraded is a warning, not an outage: 200 with the facts
+            assert doc["status"] == "degraded"
+            assert doc["durability"]["stripes_degraded"] > 0
+        finally:
+            await h.teardown()
+
+    loop.run_until_complete(run())
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name",
+                         ["steady", "churn", "byzantine", "loss", "full"])
+def test_scenario_matrix(name, tmp_path, loop):
+    card = loop.run_until_complete(
+        run_scenario(builtin_scenarios()[name], tmp_path))
+    assert card.passed, card.render()
