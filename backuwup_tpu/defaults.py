@@ -126,6 +126,11 @@ AUDIT_REPORT_WINDOW_S = 24 * 3600.0  # server aggregation window
 OBS_JOURNAL_MAX_BYTES = 4 * MiB  # rotate the JSONL journal past this size
 OBS_JOURNAL_KEEP = 3  # rotated generations retained (<path>.1 .. .keep)
 OBS_PANIC_TAIL_LINES = 200  # journal lines embedded in a panic dump
+# EWMA smoothing for the per-peer throughput/latency/success estimators
+# (net/peer_stats.py): each new TransferResult carries 20% weight, so
+# ~10 transfers dominate the estimate — reactive on WAN shifts without
+# one stalled send cratering a peer's score.
+PEER_STATS_ALPHA = 0.2
 
 # --- durability invariant monitor (obs/invariants.py, docs/scenarios.md) -----
 # Background sweep cadence of the client's InvariantMonitor; health is

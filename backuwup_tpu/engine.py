@@ -48,9 +48,11 @@ from .erasure import gf_cpu
 from .erasure import stripe as rs_stripe
 from .net.client import NoBackups, ServerClient, ServerError
 from .net.p2p import P2PError, P2PNode, Receiver, RestoreFilesWriter, Transport
+from .net.peer_stats import PeerStats
 from .net.transfer import TransferScheduler
 from .obs import invariants as obs_invariants
 from .obs import metrics as obs_metrics
+from .obs import profile as obs_profile
 from .obs import trace as obs_trace
 from .ops.backend import ChunkerBackend, select_backend
 from .snapshot.blob_index import BlobIndex, ChallengeTable
@@ -186,6 +188,12 @@ class Engine:
         self._avoid_peers: set = set()
         # transfer plane of the most recent send loop (telemetry seam)
         self._transfers: Optional[TransferScheduler] = None
+        # per-peer throughput/latency/success estimators, persisted in the
+        # client config DB (net/peer_stats.py; the WAN-aware scheduling
+        # measurement seam)
+        self.peer_stats = PeerStats(store)
+        # per-backup dispatch/bytes/padding roll-up (obs/profile.py)
+        self.last_pipeline_report = None
 
     @staticmethod
     def _default_mesh():
@@ -283,6 +291,7 @@ class Engine:
         if not root.is_dir():
             raise EngineError(f"backup path {root} is not a directory")
         stage_base = _registry_stage_sums()
+        profile_base = obs_profile.baseline()
         orch = self.orchestrator = Orchestrator()
         loop = asyncio.get_running_loop()
         # the size estimate walks the whole tree: keep it off the event
@@ -335,6 +344,14 @@ class Engine:
         self.store.add_event(EVENT_BACKUP, {
             "size": snapshot_holder["stats"].bytes_read,
             "snapshot": snapshot.hex()})
+        # per-backup pipeline report: dispatch counts, bytes, padding
+        # efficiency, stage seconds — the number the round-5 digest-merge
+        # gate watches (PERF.md)
+        self.last_pipeline_report = obs_profile.report(profile_base)
+        obs_profile.emit_report(
+            self.last_pipeline_report, snapshot=snapshot.hex(),
+            backend=getattr(self.backend, "name", "?"),
+            bytes_read=snapshot_holder["stats"].bytes_read)
         self._log(f"backup finished: {snapshot.hex()}")
         if self.messenger is not None:
             # the per-stage roll-up is now derived from the metrics
@@ -382,7 +399,8 @@ class Engine:
         # ordering, per-transfer failure isolation (net/transfer.py).  One
         # scheduler per send loop so serial/concurrent knobs re-read
         # defaults each run.
-        sched = self._transfers = TransferScheduler(messenger=self.messenger)
+        sched = self._transfers = TransferScheduler(
+            messenger=self.messenger, peer_stats=self.peer_stats)
         # unified retry shapes (utils/retry.py): the storage re-request
         # backs off across consecutive dry spells, the two pacing waits
         # grow toward their caps while idle and reset on progress
@@ -1137,7 +1155,8 @@ class Engine:
         unrebuildable = []
         loop = asyncio.get_running_loop()
         orch = Orchestrator()  # transport bookkeeping for fresh placements
-        sched = TransferScheduler(messenger=self.messenger)
+        sched = TransferScheduler(messenger=self.messenger,
+                                  peer_stats=self.peer_stats)
 
         def read_staged(d: Path) -> list:
             if not d.is_dir():

@@ -1,0 +1,154 @@
+"""Per-peer transfer estimators: EWMA throughput/latency/success.
+
+The measurement foundation for WAN-aware scheduling (ROADMAP): every
+``TransferResult`` the :class:`~backuwup_tpu.net.transfer.\
+TransferScheduler` finalizes feeds one :meth:`PeerStats.observe`, which
+
+* updates per-peer EWMAs — throughput (``size / send_s`` of successful
+  sends), latency (the full send+ack seconds), success ratio — seeded
+  at the first sample so a fresh peer isn't averaged against zero;
+* exposes them as peer-labeled gauges plus additive per-peer wait/send
+  histograms (NEW families; the PR-4 unlabeled transfer histograms keep
+  their exact series — the scorecard and engine stage sums depend on
+  them);
+* persists the EWMA state to the client config DB (``peer_stats``
+  table) so capacity knowledge survives a restart — a client that comes
+  back after a week still knows which holders were slow.
+
+Estimates are observability/scheduling hints only: they MUST never
+gate correctness (a slow peer still holds real shards).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional
+
+from .. import defaults
+from ..obs import metrics as obs_metrics
+from ..store import PeerStatsRow
+
+_THROUGHPUT = obs_metrics.gauge(
+    "bkw_peer_throughput_bytes_per_second",
+    "EWMA payload throughput per peer over successful transfers",
+    labelnames=("peer",))
+_LATENCY = obs_metrics.gauge(
+    "bkw_peer_latency_seconds",
+    "EWMA send+ack seconds per peer over successful transfers",
+    labelnames=("peer",))
+_SUCCESS = obs_metrics.gauge(
+    "bkw_peer_success_ratio",
+    "EWMA transfer success ratio per peer (1.0 = never fails)",
+    labelnames=("peer",))
+_SAMPLES = obs_metrics.counter(
+    "bkw_peer_transfer_samples_total",
+    "TransferResults folded into a peer's estimators",
+    labelnames=("peer",))
+_WAIT_SECONDS = obs_metrics.histogram(
+    "bkw_peer_transfer_wait_seconds",
+    "Scheduler admission wait per peer",
+    labelnames=("peer",))
+_SEND_SECONDS = obs_metrics.histogram(
+    "bkw_peer_transfer_send_seconds",
+    "Wire send+ack seconds per peer",
+    labelnames=("peer",))
+
+
+def peer_label(peer_id: bytes) -> str:
+    """The metric label for a peer: short hex, same truncation the
+    messenger uses for transfer frames."""
+    return bytes(peer_id).hex()[:16]
+
+
+@dataclass(frozen=True)
+class PeerEstimate:
+    """Current view of one peer (a thin alias over the persisted row)."""
+
+    peer: bytes
+    throughput_bps: float = 0.0
+    latency_s: float = 0.0
+    success: float = 1.0
+    samples: int = 0
+    updated: float = 0.0
+
+
+class PeerStats:
+    """EWMA estimator bank, optionally backed by a :class:`Store`.
+
+    Thread-safe: the scheduler finalizes results on the event loop but
+    tests and the repair path may observe from other threads.
+    """
+
+    def __init__(self, store=None, alpha: Optional[float] = None):
+        self.store = store
+        self.alpha = defaults.PEER_STATS_ALPHA if alpha is None else alpha
+        self._lock = threading.Lock()
+        self._est: Dict[bytes, PeerEstimate] = {}
+        if store is not None:
+            for row in store.all_peer_stats():
+                est = PeerEstimate(
+                    peer=bytes(row.peer),
+                    throughput_bps=row.throughput_bps,
+                    latency_s=row.latency_s, success=row.success,
+                    samples=row.samples, updated=row.updated)
+                self._est[est.peer] = est
+                self._export(est)
+
+    def _export(self, est: PeerEstimate) -> None:
+        label = peer_label(est.peer)
+        _THROUGHPUT.set(est.throughput_bps, peer=label)
+        _LATENCY.set(est.latency_s, peer=label)
+        _SUCCESS.set(est.success, peer=label)
+
+    def _ewma(self, prev: float, sample: float, first: bool) -> float:
+        if first:
+            return sample
+        return (1.0 - self.alpha) * prev + self.alpha * sample
+
+    def observe(self, result, now: Optional[float] = None) -> PeerEstimate:
+        """Fold one finalized ``TransferResult``-shaped object (needs
+        ``peer_id``/``size``/``ok``/``wait_s``/``send_s``) into the
+        peer's estimators; returns the updated estimate."""
+        peer = bytes(result.peer_id)
+        label = peer_label(peer)
+        now = time.time() if now is None else now
+        with self._lock:
+            est = self._est.get(peer, PeerEstimate(peer=peer))
+            first = est.samples == 0
+            ok = bool(result.ok)
+            success = self._ewma(est.success, 1.0 if ok else 0.0, first)
+            throughput, latency = est.throughput_bps, est.latency_s
+            if ok and result.send_s > 0:
+                # failures say nothing about capacity, only reliability:
+                # the rate estimators move on successful sends alone
+                first_ok = est.throughput_bps == 0.0 and est.latency_s == 0.0
+                throughput = self._ewma(
+                    throughput, result.size / result.send_s, first_ok)
+                latency = self._ewma(latency, result.send_s, first_ok)
+            est = replace(est, throughput_bps=throughput,
+                          latency_s=latency, success=success,
+                          samples=est.samples + 1, updated=now)
+            self._est[peer] = est
+            self._export(est)
+            _SAMPLES.inc(peer=label)
+            _WAIT_SECONDS.observe(max(result.wait_s, 0.0), peer=label)
+            _SEND_SECONDS.observe(max(result.send_s, 0.0), peer=label)
+            if self.store is not None:
+                try:
+                    self.store.put_peer_stats(PeerStatsRow(
+                        peer=peer, throughput_bps=est.throughput_bps,
+                        latency_s=est.latency_s, success=est.success,
+                        samples=est.samples, updated=est.updated))
+                except Exception:
+                    pass  # telemetry must never fail a transfer
+            return est
+
+    def get(self, peer_id: bytes) -> Optional[PeerEstimate]:
+        with self._lock:
+            return self._est.get(bytes(peer_id))
+
+    def all(self) -> List[PeerEstimate]:
+        with self._lock:
+            return list(self._est.values())

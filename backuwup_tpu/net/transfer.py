@@ -80,7 +80,8 @@ class TransferScheduler:
     """
 
     def __init__(self, max_inflight_bytes: Optional[int] = None,
-                 max_transfers: Optional[int] = None, messenger=None):
+                 max_transfers: Optional[int] = None, messenger=None,
+                 peer_stats=None):
         self.max_inflight_bytes = int(
             defaults.TRANSFER_INFLIGHT_BYTE_CAP
             if max_inflight_bytes is None else max_inflight_bytes)
@@ -88,6 +89,7 @@ class TransferScheduler:
             defaults.TRANSFER_MAX_INFLIGHT
             if max_transfers is None else max_transfers)
         self.messenger = messenger
+        self.peer_stats = peer_stats  # net/peer_stats.py estimator bank
         self.inflight_bytes = 0
         self.inflight_count = 0
         self.completed = 0
@@ -166,6 +168,11 @@ class TransferScheduler:
             _BYTES_SENT.inc(size)
         else:
             self.failed += 1
+        if self.peer_stats is not None:
+            try:
+                self.peer_stats.observe(result)
+            except Exception:
+                pass  # estimators are hints; never fail a transfer
         if self.messenger is not None:
             self.messenger.transfer(
                 peer_id.hex()[:16], "sent" if result.ok else "failed",

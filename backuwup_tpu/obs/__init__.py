@@ -23,7 +23,15 @@ reference prints ad-hoc lines and keeps no machine-readable telemetry):
   consume;
 * :mod:`~backuwup_tpu.obs.expo` — ``GET /metrics`` + ``GET /healthz``
   exposition shared by the coordination server and the opt-in client
-  status port.
+  status port;
+* :mod:`~backuwup_tpu.obs.profile` — the performance half (GWP,
+  PAPERS.md): per-stage device dispatch accounting
+  (``bkw_device_dispatch_total``), padded-vs-actual byte efficiency,
+  the honest chained-execution stage timer, and the per-backup
+  pipeline report;
+* :mod:`~backuwup_tpu.obs.timeline` — journals + spans exported as
+  Chrome trace-event JSON (Perfetto), merging multiple clients'
+  journals into one cross-process timeline keyed by trace id.
 
 Import-light by design: this package depends only on the stdlib and
 :mod:`backuwup_tpu.defaults` (``expo`` additionally on aiohttp), never
@@ -31,6 +39,7 @@ on jax or any accelerator runtime, so every layer can instrument itself
 without import cycles or device initialization.
 """
 
-from . import invariants, journal, metrics, trace
+from . import invariants, journal, metrics, profile, timeline, trace
 
-__all__ = ["invariants", "journal", "metrics", "trace"]
+__all__ = ["invariants", "journal", "metrics", "profile", "timeline",
+           "trace"]

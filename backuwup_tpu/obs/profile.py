@@ -1,0 +1,197 @@
+"""Device-pipeline profiler: dispatch accounting + honest stage timing.
+
+The performance half of the obs plane (GWP, Ren et al. — PAPERS.md):
+always-on, low-overhead counters wired into the pipeline entry points
+in :mod:`backuwup_tpu.ops.pipeline` / :mod:`backuwup_tpu.ops.backend`,
+plus the chained-execution device timer that used to live duplicated
+across ``scripts/devtime.py`` and the ``probe_*``/``profile_*`` pile.
+
+Dispatch accounting semantics (the hand-countable contract the tests
+pin; one *dispatch* = one device program launch, or its CPU-fallback
+moral equivalent):
+
+=========  =================================================================
+stage      what counts as one dispatch
+=========  =================================================================
+scan       device: one fused ``scan_select_batch``/``scan_digest_batch``
+           launch per batch.  CPU/native fallback: one ``chunk()`` pass
+           per stream (native runs the whole pipeline in one C call per
+           stream and counts once under every stage).
+select     rides the scan program on every path (fused boundary
+           selection), so it counts 1:1 with scan.
+gather     device: one ``gather_chunks``/``_gather_digest`` tile launch.
+           CPU fallback: one host piece-slicing pass per stream that
+           produced at least one chunk.
+digest     device: one batched digest launch (``_gather_digest`` tile,
+           fused scan+digest batch, or ``blake3_many_tpu`` tiny-stream
+           batch).  CPU fallback: one batched ``digest_many`` call per
+           ``manifest_many``/stream segment with at least one piece.
+index      one batched dedup classification per pack batch (device
+           ``dedup_batch`` table classify or the host blob-index pass),
+           bytes = 32 per ref classified.
+=========  =================================================================
+
+Bytes ride each dispatch twice: *actual* payload bytes and *padded*
+bytes as dispatched (tile/bucket padding included), so
+``bkw_pipeline_pad_efficiency`` exposes how much of every launch was
+real work — the number PERF.md round-5 item 1 (merging the per-class
+digest dispatches) moves.
+
+Like the rest of ``obs/`` this module is import-light: stdlib +
+defaults only; jax/numpy are imported lazily inside the timing helpers.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from . import journal as _journal
+from . import metrics as _metrics
+
+STAGES = ("scan", "select", "gather", "digest", "index")
+
+_DISPATCH = _metrics.counter(
+    "bkw_device_dispatch_total",
+    "Pipeline dispatches by logical stage (a fused program counts once "
+    "under every stage it implements)", labelnames=("stage",))
+_STAGE_BYTES = _metrics.counter(
+    "bkw_pipeline_stage_bytes_total",
+    "Actual payload bytes processed per pipeline stage",
+    labelnames=("stage",))
+_STAGE_PADDED = _metrics.counter(
+    "bkw_pipeline_stage_padded_bytes_total",
+    "Bytes as dispatched per pipeline stage, tile/bucket padding "
+    "included", labelnames=("stage",))
+_PAD_EFFICIENCY = _metrics.gauge(
+    "bkw_pipeline_pad_efficiency",
+    "Cumulative actual/padded byte ratio per stage (1.0 = no padding "
+    "waste)", labelnames=("stage",))
+_PROFILE_SECONDS = _metrics.histogram(
+    "bkw_profile_stage_seconds",
+    "Honest chained-execution device seconds per profiled stage "
+    "(dev_time_stage)", labelnames=("stage",))
+
+# Span names whose bkw_span_seconds sums a pipeline report attributes as
+# per-stage wall time (the device pipeline's dispatch/collect pairs plus
+# the packer entry point that drives them).
+REPORT_SPANS = (
+    "pipeline.scan_select_dispatch",
+    "pipeline.cut_collect",
+    "pipeline.digest_dispatch",
+    "pipeline.digest_collect",
+    "pipeline.scan_digest_dispatch",
+    "pipeline.scan_digest_collect",
+    "packer.manifest_many",
+)
+
+
+def dispatch(stage: str, count: int = 1, actual_bytes: int = 0,
+             padded_bytes: int = 0) -> None:
+    """Record ``count`` dispatches for ``stage`` (see the module table
+    for what counts as one).  Cheap enough to be always on."""
+    if stage not in STAGES:
+        raise ValueError(f"unknown pipeline stage {stage!r}")
+    _DISPATCH.inc(count, stage=stage)
+    if actual_bytes:
+        _STAGE_BYTES.inc(actual_bytes, stage=stage)
+    if padded_bytes:
+        _STAGE_PADDED.inc(padded_bytes, stage=stage)
+        padded = _STAGE_PADDED.value(stage=stage)
+        if padded > 0:
+            _PAD_EFFICIENCY.set(
+                _STAGE_BYTES.value(stage=stage) / padded, stage=stage)
+
+
+# --- honest device timing (the scripts/devtime.py technique) ----------------
+
+def _sync(out):
+    """Force one tiny device->host download: block_until_ready lies on
+    the dev rig, but a 1-element ``np.asarray`` cannot return before the
+    producing computation finished."""
+    import jax
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return np.asarray(leaf.ravel()[0])
+
+
+def dev_time(fn, *args, n: int = 20) -> float:
+    """Honest per-call device seconds for ``fn(*args)``.
+
+    Times ``n`` chained executions plus ONE tiny download, subtracts the
+    download-only baseline, and averages — dispatch overhead amortises
+    while the sync cost cancels.  Callers must pass already-jitted
+    callables with device-resident args."""
+    out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    _sync(out)
+    base = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _sync(out)
+    total = time.perf_counter() - t0
+    return max(total - base, 1e-9) / n
+
+
+def dev_time_stage(stage: str, fn, *args, n: int = 20) -> float:
+    """:func:`dev_time` with the registry as sink: observes the result
+    into ``bkw_profile_stage_seconds{stage}`` and journals a ``profile``
+    event so one-off probe runs leave a durable record."""
+    dt = dev_time(fn, *args, n=n)
+    _PROFILE_SECONDS.observe(dt, stage=stage)
+    _journal.emit("profile", stage=stage, dev_s=round(dt, 9), n=n)
+    return dt
+
+
+# --- per-backup pipeline report ---------------------------------------------
+
+def baseline() -> Dict[str, Dict[str, float]]:
+    """Snapshot the profiler families so :func:`report` can attribute a
+    delta to one backup (the engine's ``_registry_stage_sums`` idiom)."""
+    out = {"dispatch": {}, "bytes": {}, "padded": {}, "span_s": {}}
+    for stage in STAGES:
+        out["dispatch"][stage] = _DISPATCH.value(stage=stage)
+        out["bytes"][stage] = _STAGE_BYTES.value(stage=stage)
+        out["padded"][stage] = _STAGE_PADDED.value(stage=stage)
+    spans = _metrics.registry().get("bkw_span_seconds")
+    if spans is not None:
+        for name in REPORT_SPANS:
+            out["span_s"][name] = spans.sum_value(name=name)
+    return out
+
+
+def report(base: Optional[dict] = None) -> dict:
+    """Dispatch counts, bytes, padding efficiency, and stage seconds
+    since ``base`` (or process start when ``base`` is None)."""
+    now = baseline()
+    base = base or {}
+
+    def _delta(section: str) -> Dict[str, float]:
+        prior = base.get(section, {})
+        return {k: v - prior.get(k, 0.0) for k, v in now[section].items()}
+
+    dispatches = {k: int(v) for k, v in _delta("dispatch").items()}
+    actual = {k: int(v) for k, v in _delta("bytes").items()}
+    padded = {k: int(v) for k, v in _delta("padded").items()}
+    efficiency = {
+        stage: (round(actual[stage] / padded[stage], 6)
+                if padded[stage] > 0 else None)
+        for stage in STAGES}
+    stage_seconds = {name: round(dt, 6)
+                     for name, dt in _delta("span_s").items() if dt > 0}
+    return {
+        "dispatches": dispatches,
+        "bytes": actual,
+        "padded_bytes": padded,
+        "pad_efficiency": efficiency,
+        "stage_seconds": stage_seconds,
+    }
+
+
+def emit_report(rep: dict, **fields) -> None:
+    """Journal one ``pipeline_report`` event (no-op without a journal,
+    like every obs emission)."""
+    _journal.emit("pipeline_report", report=rep, **fields)

@@ -23,6 +23,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from ..erasure import gf_cpu
+from ..obs import profile as obs_profile
 from .blake3_cpu import blake3_many
 from .blake3_tpu import blake3_many_tpu
 from .cdc_cpu import chunk_stream as chunk_stream_cpu
@@ -74,13 +75,29 @@ class ChunkerBackend:
         return np.stack([gf_cpu.gf_matmul(rec, s) for s in stripes])
 
     def manifest_many(self, streams: Sequence[bytes]) -> List[List[ChunkRef]]:
-        """Chunk + fingerprint a batch of streams in one pipeline pass."""
+        """Chunk + fingerprint a batch of streams in one pipeline pass.
+
+        Dispatch accounting (obs/profile.py, exact on the CPU fallback):
+        one scan + one select per stream, one gather per stream that
+        produced chunks, one batched digest per call with pieces."""
         all_chunks = []  # (stream_idx, offset, length)
         pieces = []
         for i, data in enumerate(streams):
+            n = len(data)
+            obs_profile.dispatch("scan", actual_bytes=n, padded_bytes=n)
+            obs_profile.dispatch("select", actual_bytes=n, padded_bytes=n)
+            gathered = 0
             for off, ln in self.chunk(data):
                 all_chunks.append((i, off, ln))
                 pieces.append(bytes(data[off:off + ln]))
+                gathered += ln
+            if gathered:
+                obs_profile.dispatch("gather", actual_bytes=gathered,
+                                     padded_bytes=gathered)
+        if pieces:
+            total = sum(len(p) for p in pieces)
+            obs_profile.dispatch("digest", actual_bytes=total,
+                                 padded_bytes=total)
         digests = self.digest_many(pieces)
         out: List[List[ChunkRef]] = [[] for _ in streams]
         for (i, off, ln), h in zip(all_chunks, digests):
@@ -111,6 +128,10 @@ class ChunkerBackend:
             eof = not segment
             buf = carry + segment
             chunks = self.chunk(buf)
+            obs_profile.dispatch("scan", actual_bytes=len(buf),
+                                 padded_bytes=len(buf))
+            obs_profile.dispatch("select", actual_bytes=len(buf),
+                                 padded_bytes=len(buf))
             if eof:
                 final, carry, next_base = chunks, b"", base
             elif len(chunks) > 1:
@@ -121,6 +142,12 @@ class ChunkerBackend:
                 # single chunk that may still grow: carry everything
                 final, carry, next_base = [], buf, base
             pieces = [buf[off:off + ln] for off, ln in final]
+            if pieces:
+                total = sum(len(p) for p in pieces)
+                obs_profile.dispatch("gather", actual_bytes=total,
+                                     padded_bytes=total)
+                obs_profile.dispatch("digest", actual_bytes=total,
+                                     padded_bytes=total)
             for h, (off, ln), data in zip(self.digest_many(pieces), final,
                                           pieces):
                 ref = ChunkRef(offset=base + off, length=ln, hash=h)
@@ -175,6 +202,11 @@ class NativeBackend(ChunkerBackend):
         for data in streams:
             chunks, digests = self._native.manifest_native(
                 bytes(data), self.params)
+            # the C pipeline fuses the whole chain into one host call per
+            # stream: it counts once under every stage
+            n = len(data)
+            for stage in ("scan", "select", "gather", "digest"):
+                obs_profile.dispatch(stage, actual_bytes=n, padded_bytes=n)
             out.append([ChunkRef(offset=off, length=ln, hash=h)
                         for (off, ln), h in zip(chunks, digests)])
         return out

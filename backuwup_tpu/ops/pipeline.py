@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import profile as obs_profile
 from ..utils import tracing
 from .blake3_tpu import blake3_many_tpu, digest_padded
 from .cdc_cpu import chunk_stream as chunk_stream_cpu
@@ -239,6 +240,12 @@ class DevicePipeline:
                 max_size=p.max_size, mask_s=p.mask_s, mask_l=p.mask_l,
                 s_cap=s_cap, l_cap=l_cap, cut_cap=cut_cap, fused=self.fused)
         _async_to_host(packed_d)
+        actual = int(np.asarray(nv, dtype=np.int64).sum())
+        padded_total = int(buf_d.shape[0]) * padded
+        obs_profile.dispatch("scan", actual_bytes=actual,
+                             padded_bytes=padded_total)
+        obs_profile.dispatch("select", actual_bytes=actual,
+                             padded_bytes=padded_total)
         return packed_d
 
     def scan_select_collect(self, packed_d: jnp.ndarray, buf_d: jnp.ndarray,
@@ -318,6 +325,12 @@ class DevicePipeline:
             for i, (_st, Bb, Lb, _tags) in enumerate(tiles):
                 acc = _gather_digest(flat, meta, meta[2, i], acc,
                                      B=Bb, L=Lb)
+                tile_actual = int(lens_parts[i].sum())
+                tile_padded = Bb * Lb * CHUNK_LEN
+                obs_profile.dispatch("gather", actual_bytes=tile_actual,
+                                     padded_bytes=tile_padded)
+                obs_profile.dispatch("digest", actual_bytes=tile_actual,
+                                     padded_bytes=tile_padded)
         _async_to_host(acc)
         return acc, tiles
 
@@ -453,6 +466,11 @@ class DevicePipeline:
                             pallas_digest=self.pallas_digest)
                 for a in (packed, acc, ovf):
                     _async_to_host(a)
+                actual = int(np.asarray(nv, dtype=np.int64).sum())
+                padded_total = B * padded
+                for stage in ("scan", "select", "gather", "digest"):
+                    obs_profile.dispatch(stage, actual_bytes=actual,
+                                         padded_bytes=padded_total)
                 pending.append((buf_d, nv, cut_cap, packed, acc, ovf))
                 return True
             return False
@@ -536,12 +554,18 @@ class DevicePipeline:
             elif n > self.scanner.segment_size:
                 # long stream: segmented device scan, then resident digest
                 chunks = self.scanner.chunk_stream(s)
+                obs_profile.dispatch("scan", actual_bytes=n, padded_bytes=n)
+                obs_profile.dispatch("select", actual_bytes=n,
+                                     padded_bytes=n)
                 dev = jnp.asarray(np.frombuffer(bytes(s), dtype=np.uint8))
                 out[i] = (chunks, self.digest_chunks(dev, chunks))
             else:
                 groups.setdefault(_segment_bucket(n), []).append(i)
         if tiny:
             digs = blake3_many_tpu([streams[i] for i in tiny])
+            tiny_bytes = sum(len(streams[i]) for i in tiny)
+            obs_profile.dispatch("digest", actual_bytes=tiny_bytes,
+                                 padded_bytes=tiny_bytes)
             for i, d in zip(tiny, digs):
                 out[i] = ([(0, len(streams[i]))],
                           np.frombuffer(d, dtype=np.uint8).reshape(1, 32))
@@ -615,6 +639,12 @@ class DevicePipeline:
                 buf = gather_chunks(stream, jnp.asarray(offs), l_bucket=L)
                 root = digest_padded(buf.reshape(bb, L * CHUNK_LEN),
                                      jnp.asarray(lens), L=L)
+                tile_actual = int(lens.sum())
+                tile_padded = bb * L * CHUNK_LEN
+                obs_profile.dispatch("gather", actual_bytes=tile_actual,
+                                     padded_bytes=tile_padded)
+                obs_profile.dispatch("digest", actual_bytes=tile_actual,
+                                     padded_bytes=tile_padded)
                 got = np.ascontiguousarray(np.asarray(root).astype("<u4"))
                 got = got.view(np.uint8).reshape(bb, 32)
                 for j, i in enumerate(part):

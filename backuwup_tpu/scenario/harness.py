@@ -463,6 +463,22 @@ class ScenarioHarness:
             out.append(A("shards_rebuilt",
                          rebuilt >= spec.min_shards_rebuilt,
                          f"{rebuilt:g} >= {spec.min_shards_rebuilt}"))
+        if want_backups:
+            # performance telemetry must keep flowing: every backup's
+            # chunk pipeline feeds bkw_device_dispatch_total and every
+            # finalized transfer feeds a per-peer estimator sample — a
+            # zero delta here means the profiler or PeerStats wiring
+            # silently died (PR 7)
+            dispatches = sum(
+                v for k, v in counters.items()
+                if k.startswith("bkw_device_dispatch_total"))
+            samples = sum(
+                v for k, v in counters.items()
+                if k.startswith("bkw_peer_transfer_samples_total"))
+            out.append(A("telemetry_flowing",
+                         dispatches > 0 and samples > 0,
+                         f"dispatches={dispatches:g}"
+                         f" peer_samples={samples:g}"))
         return out
 
 

@@ -50,6 +50,11 @@ COUNTER_FAMILIES = (
     "bkw_erasure_events_total",
     "bkw_durability_sweeps_total",
     "bkw_durability_violation_seconds_total",
+    # performance plane (PR 7): pipeline dispatch accounting and the
+    # per-peer estimator feed — the telemetry_flowing gate reads these
+    "bkw_device_dispatch_total",
+    "bkw_pipeline_stage_bytes_total",
+    "bkw_peer_transfer_samples_total",
 )
 
 #: Histogram families quantiled in the card.
@@ -58,6 +63,8 @@ HISTOGRAM_FAMILIES = (
     "bkw_transfer_wait_seconds",
     "bkw_transfer_send_seconds",
     "bkw_pack_stage_seconds",
+    "bkw_peer_transfer_wait_seconds",
+    "bkw_peer_transfer_send_seconds",
 )
 
 

@@ -28,6 +28,7 @@ from typing import Callable, List, Optional
 
 from .. import defaults
 from ..obs import metrics as obs_metrics
+from ..obs import profile as obs_profile
 from ..ops.backend import ChunkerBackend
 from ..ops.blake3_cpu import blake3_hash
 from ..utils import tracing
@@ -149,6 +150,12 @@ class DirPacker:
             dt = time.monotonic() - t0
             self.stats.chunk_hash_s += dt
             _STAGE_SECONDS.observe(dt, stage="chunk_hash")
+            total_refs = sum(len(m) for m in manifests)
+            if total_refs:
+                # one batched dedup classification per pack batch, whether
+                # the device table or the host blob index answers it
+                obs_profile.dispatch("index", actual_bytes=32 * total_refs,
+                                     padded_bytes=32 * total_refs)
             hints = iter(())
             if self.dedup_batch is not None:
                 # blobs classified host-side since the last batch (streamed
@@ -261,6 +268,11 @@ class DirPacker:
         dt = time.monotonic() - t0
         self.stats.chunk_hash_s += dt
         _STAGE_SECONDS.observe(dt, stage="chunk_hash")
+        if children:
+            # the streamed file's chunks were classified host-side one by
+            # one; account them as a single per-file dedup pass
+            obs_profile.dispatch("index", actual_bytes=32 * len(children),
+                                 padded_bytes=32 * len(children))
         self.stats.files += 1
         self.progress(file=str(path), bytes=st.st_size)
         return self._tree_with_split(

@@ -69,6 +69,14 @@ CREATE TABLE IF NOT EXISTS audit_ledger (
     last_audit REAL NOT NULL DEFAULT 0,
     next_due REAL NOT NULL DEFAULT 0
 );
+CREATE TABLE IF NOT EXISTS peer_stats (
+    peer BLOB PRIMARY KEY,
+    throughput_bps REAL NOT NULL DEFAULT 0,
+    latency_s REAL NOT NULL DEFAULT 0,
+    success REAL NOT NULL DEFAULT 1,
+    samples INTEGER NOT NULL DEFAULT 0,
+    updated REAL NOT NULL DEFAULT 0
+);
 """
 
 EVENT_BACKUP = "backup"
@@ -100,6 +108,21 @@ class AuditState:
     last_result: str = ""
     last_audit: float = 0.0
     next_due: float = 0.0
+
+
+@dataclass(frozen=True)
+class PeerStatsRow:
+    """One peer's persisted transfer estimators (net/peer_stats.py; no
+    reference equivalent).  EWMA state, not raw telemetry — the
+    histograms live in the metrics registry and reset with the process;
+    this row is what survives a client restart."""
+
+    peer: bytes
+    throughput_bps: float = 0.0
+    latency_s: float = 0.0
+    success: float = 1.0
+    samples: int = 0
+    updated: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -451,6 +474,41 @@ class Store:
                 AuditState(st.peer, st.passes, st.failures, st.misses,
                            st.consecutive_failures, st.consecutive_misses,
                            st.demoted, st.last_result, st.last_audit, now))
+
+    # --- per-peer transfer estimators (net/peer_stats.py) -------------------
+
+    def get_peer_stats(self, peer: bytes) -> Optional["PeerStatsRow"]:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT peer, throughput_bps, latency_s, success, samples,"
+                " updated FROM peer_stats WHERE peer = ?",
+                (bytes(peer),)).fetchone()
+        if row is None:
+            return None
+        return PeerStatsRow(bytes(row[0]), *row[1:])
+
+    def put_peer_stats(self, row: "PeerStatsRow") -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO peer_stats (peer, throughput_bps, latency_s,"
+                " success, samples, updated) VALUES (?, ?, ?, ?, ?, ?)"
+                " ON CONFLICT(peer) DO UPDATE SET"
+                " throughput_bps = excluded.throughput_bps,"
+                " latency_s = excluded.latency_s,"
+                " success = excluded.success,"
+                " samples = excluded.samples,"
+                " updated = excluded.updated",
+                (bytes(row.peer), float(row.throughput_bps),
+                 float(row.latency_s), float(row.success),
+                 int(row.samples), float(row.updated)))
+            self._db.commit()
+
+    def all_peer_stats(self) -> list:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT peer, throughput_bps, latency_s, success, samples,"
+                " updated FROM peer_stats").fetchall()
+        return [PeerStatsRow(bytes(r[0]), *r[1:]) for r in rows]
 
     # --- audit challenge cursor (single-use table entries) ------------------
 

@@ -52,6 +52,15 @@ def _metrics_snapshot() -> dict:
     return obs_metrics.registry().snapshot()
 
 
+def _pipeline_report() -> dict:
+    """Whole-run pipeline report (obs/profile.py): dispatch counts per
+    stage, bytes, padding efficiency.  bench runs in a fresh process, so
+    process totals ARE this run — the before/after the round-5
+    digest-dispatch merge diffs (PERF.md)."""
+    from backuwup_tpu.obs import profile as obs_profile
+    return obs_profile.report()
+
+
 def main() -> None:
     from backuwup_tpu.utils.jaxcache import enable_compilation_cache
     enable_compilation_cache()
@@ -231,6 +240,7 @@ def main() -> None:
         "note": "corpus synthesized on-device (host<->device relay tunnel "
                 "~6 MiB/s would measure the tunnel, not the kernels); "
                 "parity vs CPU oracle gated per config",
+        "pipeline_report": _pipeline_report(),
         "metrics": _metrics_snapshot(),
     }))
 
@@ -279,6 +289,7 @@ def _cpu_fallback_report() -> None:
         "note": "HOST-pipeline measurement — the device never initialized;"
                 " PERF.md and the last BENCH_r*.json hold the most recent"
                 " device numbers",
+        "pipeline_report": _pipeline_report(),
         "metrics": _metrics_snapshot()}))
 
 

@@ -1,33 +1,16 @@
-"""Honest device timing on the axon relay.
+"""Thin wrapper: the honest chained-execution device timer now lives in
+``backuwup_tpu.obs.profile`` (promoted to a library API with the metrics
+registry as its sink — see docs/observability.md).  This shim keeps
+every ``from scripts.devtime import dev_time`` in the probe scripts and
+the recovery runbook working unchanged."""
 
-``jax.block_until_ready`` does not wait for device completion on this
-rig (measured: a 256 MiB scan "completes" in 0.08 ms, below the HBM
-read floor), so wall-clock timing needs a forced host download to sync.
-``dev_time`` times N back-to-back executions followed by ONE tiny
-download and subtracts the download-only baseline — the relay latency is
-paid once, device executions queue and run back to back.
-"""
-import time
+import os
+import sys
 
-import numpy as np
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-
-def _sync(out):
-    import jax
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    return np.asarray(leaf.ravel()[0])
-
-
-def dev_time(fn, *args, n=20):
-    """Seconds of device time per execution of ``fn(*args)``."""
-    out = fn(*args)  # warm / compile
-    _sync(out)
-    t0 = time.time()
-    _sync(out)
-    base = time.time() - t0  # download-only round trip on a ready value
-    t0 = time.time()
-    for _ in range(n):
-        out = fn(*args)
-    _sync(out)
-    total = time.time() - t0
-    return max(total - base, 1e-9) / n
+from backuwup_tpu.obs.profile import (  # noqa: E402,F401
+    _sync,
+    dev_time,
+    dev_time_stage,
+)

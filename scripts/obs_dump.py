@@ -10,9 +10,21 @@ Three read-only views, no accelerator and no repo imports beyond stdlib:
   and prints only the samples that changed, with their deltas.
 * ``--journal PATH [-n N]`` — tail the last N parsed lines of a JSONL
   journal written under ``BKW_JOURNAL``; ``--trace TID`` filters to one
-  correlated trace.
+  correlated trace.  Repeatable: several clients' journals concatenate.
 * ``--panic PATH`` — pretty-print a ``<journal>.panic.json`` flight-
   recorder dump (metrics snapshot + journal tail at panic time).
+
+Plus one export: ``--journal PATH [--journal PATH2 ...] --timeline
+out.json`` merges the journals into one Chrome trace-event document
+loadable in Perfetto (ui.perfetto.dev), one process row per journal,
+cross-process spans correlated by the trace ids on the wire envelopes;
+``--trace TID`` cuts it to one backup.  (Timeline export is the one
+mode that imports the repo — ``backuwup_tpu.obs.timeline`` — since the
+span-to-event mapping must not fork from the library.)
+
+The ``--url`` view surfaces the per-peer transfer estimators
+(``bkw_peer_*`` gauges, net/peer_stats.py) as ``~ peer`` summary lines
+next to the generic per-series histogram p50/p99 lines.
 """
 
 from __future__ import annotations
@@ -109,9 +121,40 @@ def _histogram_quantiles(samples: dict, prev=None) -> "list[str]":
     return lines
 
 
+_PEER_GAUGE_RE = re.compile(
+    r'^(?P<name>bkw_peer_(?:throughput_bytes_per_second|latency_seconds'
+    r'|success_ratio|transfer_samples_total))\{peer="(?P<peer>[^"]*)"\} $')
+
+_PEER_FIELDS = {
+    "bkw_peer_throughput_bytes_per_second": ("tput_MiBs", 1 / (1 << 20)),
+    "bkw_peer_latency_seconds": ("lat_s", 1.0),
+    "bkw_peer_success_ratio": ("success", 1.0),
+    "bkw_peer_transfer_samples_total": ("n", 1.0),
+}
+
+
+def _peer_lines(samples: dict) -> "list[str]":
+    """One summary line per peer from the estimator gauges
+    (net/peer_stats.py): throughput, latency, success ratio, samples."""
+    peers: dict = {}
+    for key, value in samples.items():
+        m = _PEER_GAUGE_RE.match(key + " ")
+        if not m:
+            continue
+        field, scale = _PEER_FIELDS[m.group("name")]
+        peers.setdefault(m.group("peer"), {})[field] = value * scale
+    lines = []
+    for peer, fields in sorted(peers.items()):
+        parts = " ".join(f"{k}={fields[k]:.6g}"
+                         for k in ("tput_MiBs", "lat_s", "success", "n")
+                         if k in fields)
+        lines.append(f"~ peer {peer} {parts}")
+    return lines
+
+
 def _print_view(samples: dict, prev=None) -> None:
     """Non-zero samples (first poll) or changed-with-delta (re-polls),
-    then the histogram quantile summary lines."""
+    then the histogram quantile and per-peer estimator summary lines."""
     for key, value in samples.items():
         if prev is None:
             # keep the catalog readable: hide never-touched zero samples
@@ -123,6 +166,8 @@ def _print_view(samples: dict, prev=None) -> None:
             if delta != 0.0:
                 print(f"{key} {value:g} ({delta:+g})")
     for line in _histogram_quantiles(samples, prev=prev):
+        print(line)
+    for line in _peer_lines(samples):
         print(line)
 
 
@@ -141,22 +186,34 @@ def dump_metrics(url: str, raw: bool, watch: float) -> int:
     return 0
 
 
-def dump_journal(path: str, lines: int, trace: str) -> int:
+def dump_journal(paths, lines: int, trace: str) -> int:
     kept = []
-    with open(path, "r", encoding="utf-8") as f:
-        for raw in f:
-            raw = raw.strip()
-            if not raw:
-                continue
-            try:
-                doc = json.loads(raw)
-            except ValueError:
-                continue  # torn tail line from a crash mid-write
-            if trace and doc.get("trace_id") != trace:
-                continue
-            kept.append(doc)
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    doc = json.loads(raw)
+                except ValueError:
+                    continue  # torn tail line from a crash mid-write
+                if trace and doc.get("trace_id") != trace:
+                    continue
+                kept.append(doc)
     for doc in kept[-lines:]:
         print(json.dumps(doc, sort_keys=True))
+    return 0
+
+
+def dump_timeline(paths, out: str, trace: str) -> int:
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    from backuwup_tpu.obs import timeline
+
+    doc = timeline.export_timeline(paths, out, trace_id=trace or None)
+    print(f"{len(doc['traceEvents'])} trace events -> {out} "
+          f"(load in ui.perfetto.dev)")
     return 0
 
 
@@ -170,12 +227,17 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     src = ap.add_mutually_exclusive_group(required=True)
     src.add_argument("--url", help="base URL of a /metrics endpoint")
-    src.add_argument("--journal", help="path to a BKW_JOURNAL JSONL file")
+    src.add_argument("--journal", action="append",
+                    help="path to a BKW_JOURNAL JSONL file (repeatable:"
+                         " merge several clients' journals)")
     src.add_argument("--panic", help="path to a <journal>.panic.json dump")
     ap.add_argument("-n", "--lines", type=int, default=50,
                     help="journal lines to show (default 50)")
     ap.add_argument("--trace", default="",
                     help="only journal lines with this trace_id")
+    ap.add_argument("--timeline", default="", metavar="OUT",
+                    help="with --journal: write a Perfetto-loadable Chrome"
+                         " trace-event JSON merging the journals")
     ap.add_argument("--raw", action="store_true",
                     help="with --url: full exposition incl. zero samples")
     ap.add_argument("--watch", type=float, default=0.0, metavar="N",
@@ -188,6 +250,8 @@ def main(argv=None) -> int:
         except KeyboardInterrupt:
             return 0
     if args.journal:
+        if args.timeline:
+            return dump_timeline(args.journal, args.timeline, args.trace)
         return dump_journal(args.journal, args.lines, args.trace)
     return dump_panic(args.panic)
 

@@ -6,7 +6,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from scripts.devtime import dev_time
+from backuwup_tpu.obs.profile import dev_time
 
 
 def main():

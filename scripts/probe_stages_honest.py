@@ -1,4 +1,5 @@
-"""Honest per-stage device timings (chained-execution sync; see devtime.py)."""
+"""Honest per-stage device timings (chained-execution sync; see
+backuwup_tpu/obs/profile.py)."""
 import os
 import sys
 
@@ -6,7 +7,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from scripts.devtime import dev_time
+from backuwup_tpu.obs.profile import dev_time
 
 
 def main():

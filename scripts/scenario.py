@@ -23,6 +23,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from backuwup_tpu.obs import journal as obs_journal  # noqa: E402
+from backuwup_tpu.obs import timeline as obs_timeline  # noqa: E402
 from backuwup_tpu.scenario import builtin_scenarios, run_scenario  # noqa: E402
 
 
@@ -40,6 +42,9 @@ def main() -> int:
                     help="write the raw invariant samples (JSONL) here")
     ap.add_argument("--workdir", default=None,
                     help="run here instead of a throwaway temp dir")
+    ap.add_argument("--profile", default=None, metavar="OUT",
+                    help="journal the run and write a Perfetto-loadable"
+                         " timeline JSON of the composed run here")
     args = ap.parse_args()
 
     scenarios = builtin_scenarios()
@@ -57,7 +62,22 @@ def main() -> int:
         spec = dataclasses.replace(spec, seed=args.seed)
 
     def run_in(workdir: Path):
-        return asyncio.run(run_scenario(spec, workdir))
+        if not args.profile:
+            return asyncio.run(run_scenario(spec, workdir))
+        # every client in the harness shares this process, so one
+        # installed journal captures all sides' spans; the timeline
+        # export then shows pack/seal/send/store overlap across peers,
+        # correlated by the trace ids on the wire envelopes
+        jr = obs_journal.install(
+            obs_journal.Journal(workdir / "scenario_journal.jsonl"))
+        try:
+            return asyncio.run(run_scenario(spec, workdir))
+        finally:
+            obs_journal.uninstall()
+            doc = obs_timeline.export_timeline(
+                jr.files(), args.profile, labels=[spec.name])
+            print(f"{len(doc['traceEvents'])} trace events -> "
+                  f"{args.profile} (load in ui.perfetto.dev)")
 
     if args.workdir:
         workdir = Path(args.workdir)

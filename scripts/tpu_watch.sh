@@ -39,6 +39,19 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "transfer bench recapture FAILED (see $trf) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated pipeline-profile recapture: headline device run with
+        # configs off, 1 GiB — the embedded pipeline_report (per-stage
+        # dispatch counts, padding efficiency; obs/profile.py) is the
+        # before/after for the round-5 digest-dispatch merge (PERF.md)
+        # even when the full suite above timed out partway
+        prf="$BENCH_OUT_DIR/BENCH_pipeline_${stamp}.json"
+        if timeout "${BENCH_PIPELINE_TIMEOUT_S:-600}" \
+                env BENCH_CONFIGS=0 BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$prf" 2>>/tmp/tpu_watch.log; then
+            echo "pipeline bench recaptured to $prf at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "pipeline bench recapture FAILED (see $prf) at $(date)" >> /tmp/tpu_watch.log
+        fi
         # dedicated scenario recapture: config #9 alone (host-only
         # composed chaos scenario + scorecard) — the durability gate
         # verdict survives even when the device suite timed out partway

@@ -62,12 +62,23 @@ def pytest_configure(config):
         "markers", "scenario: composed chaos scenario runs"
         " (scenario/harness.py); the fast seeded ones are tier-1, the"
         " full matrix is also marked slow")
+    config.addinivalue_line(
+        "markers", "profile: timing-sensitive profiling tests"
+        " (obs/profile.py dev timer); excluded from tier-1 like accel —"
+        " set BKW_PROFILE_TESTS=1 to run them")
 
 
 def pytest_collection_modifyitems(config, items):
     """Device-only tests (``@pytest.mark.accel``) skip on the CPU host
     platform instead of failing — mirroring the runtime-probe skip the
     blake3 device tests use, but declaratively."""
+    if os.environ.get("BKW_PROFILE_TESTS", "") != "1":
+        skip_profile = pytest.mark.skip(
+            reason="profile-marked timing test (BKW_PROFILE_TESTS=1 to"
+            " run)")
+        for item in items:
+            if item.get_closest_marker("profile"):
+                item.add_marker(skip_profile)
     import jax
     if jax.default_backend() != "cpu":
         return

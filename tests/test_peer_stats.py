@@ -1,0 +1,173 @@
+"""Per-peer transfer estimators (net/peer_stats.py).
+
+EWMA semantics (first-sample seeding, failures moving only the success
+ratio), convergence under fault-plane-injected latency through the real
+TransferScheduler, and persistence of the estimator rows across a
+client restart (Store close + reopen).
+"""
+
+import asyncio
+
+import pytest
+
+from backuwup_tpu import defaults
+from backuwup_tpu.net.p2p import P2PError
+from backuwup_tpu.net.peer_stats import PeerStats, peer_label
+from backuwup_tpu.net.transfer import TransferResult, TransferScheduler
+from backuwup_tpu.obs import metrics as obs_metrics
+from backuwup_tpu.store import PeerStatsRow, Store
+from backuwup_tpu.utils import faults
+
+pytestmark = pytest.mark.concurrency
+
+PEER = b"\x11" * 32
+
+
+@pytest.fixture
+def plane():
+    p = faults.install(faults.FaultPlane(seed=77))
+    yield p
+    faults.uninstall()
+
+
+def _ok(size=1 << 20, send_s=0.1, wait_s=0.0):
+    return TransferResult(PEER, size, True, wait_s=wait_s, send_s=send_s)
+
+
+def _fail(size=1 << 20, send_s=0.05):
+    return TransferResult(PEER, size, False,
+                          error=P2PError("injected"), send_s=send_s)
+
+
+def test_first_sample_seeds_the_estimators():
+    ps = PeerStats(alpha=0.2)
+    est = ps.observe(_ok(size=2 << 20, send_s=0.5), now=100.0)
+    # seeded, not averaged against the zero prior
+    assert est.throughput_bps == (2 << 20) / 0.5
+    assert est.latency_s == 0.5
+    assert est.success == 1.0
+    assert est.samples == 1
+    assert est.updated == 100.0
+    assert ps.get(PEER) == est
+    assert ps.get(b"\x22" * 32) is None
+
+
+def test_ewma_moves_by_alpha():
+    ps = PeerStats(alpha=0.5)
+    ps.observe(_ok(size=1000, send_s=1.0))  # seed: 1000 B/s, 1.0 s
+    est = ps.observe(_ok(size=3000, send_s=1.0))  # sample: 3000 B/s
+    assert est.throughput_bps == pytest.approx(2000.0)
+    assert est.latency_s == pytest.approx(1.0)
+    assert est.samples == 2
+
+
+def test_failures_move_success_but_not_rates():
+    ps = PeerStats(alpha=0.5)
+    seed = ps.observe(_ok(size=1000, send_s=1.0))
+    est = ps.observe(_fail())
+    # reliability decays, capacity knowledge is untouched
+    assert est.success == pytest.approx(0.5)
+    assert est.throughput_bps == seed.throughput_bps
+    assert est.latency_s == seed.latency_s
+    # a failure-first peer still seeds its rates on the first success
+    ps2 = PeerStats(alpha=0.5)
+    ps2.observe(_fail())
+    est2 = ps2.observe(_ok(size=1000, send_s=1.0))
+    assert est2.throughput_bps == pytest.approx(1000.0)
+    assert est2.success == pytest.approx(0.5)
+
+
+def test_convergence_under_fault_plane_latency(plane):
+    """Real TransferScheduler + injected 80 ms per-send latency: after a
+    stripe's worth of transfers the latency EWMA must sit right on the
+    injected floor and the samples counter must match exactly."""
+    plane.latency = 1.0  # every send draws the sleep
+    plane.latency_s = 0.08
+    ps = PeerStats(alpha=0.3)
+    sched = TransferScheduler(peer_stats=ps)
+    size = 64 * 1024
+
+    async def send():
+        await faults.PLANE.on_send(PEER)
+
+    async def go():
+        tasks = [sched.submit(PEER, size, send, label=f"s{i}")
+                 for i in range(8)]
+        return await TransferScheduler.gather(tasks)
+
+    loop = asyncio.new_event_loop()
+    try:
+        results = loop.run_until_complete(asyncio.wait_for(go(), 30))
+    finally:
+        loop.close()
+    assert all(r.ok for r in results)
+    est = ps.get(PEER)
+    assert est.samples == 8
+    assert est.success == pytest.approx(1.0)
+    # every sample's send_s >= the injected floor, so the EWMA is too;
+    # loopback overhead stays well under one extra latency window
+    assert 0.08 <= est.latency_s < 0.16
+    assert 0 < est.throughput_bps <= size / 0.08
+    # the per-peer histograms saw every transfer
+    label = peer_label(PEER)
+    sends = obs_metrics.registry().get("bkw_peer_transfer_send_seconds")
+    assert sends.sum_value(peer=label) >= 8 * 0.08
+
+
+def test_estimators_persist_across_client_restart(tmp_path):
+    store = Store(directory=tmp_path / "cfg", data_base=tmp_path / "data")
+    ps = PeerStats(store, alpha=0.2)
+    ps.observe(_ok(size=1 << 20, send_s=0.1), now=50.0)
+    ps.observe(_ok(size=1 << 20, send_s=0.3), now=60.0)
+    ps.observe(_fail(), now=70.0)
+    before = ps.get(PEER)
+    store.close()
+
+    # the restart: fresh Store handle, fresh estimator bank
+    store2 = Store(directory=tmp_path / "cfg", data_base=tmp_path / "data")
+    try:
+        ps2 = PeerStats(store2, alpha=0.2)
+        after = ps2.get(PEER)
+        assert after is not None
+        assert after.samples == 3
+        assert after.throughput_bps == pytest.approx(before.throughput_bps)
+        assert after.latency_s == pytest.approx(before.latency_s)
+        assert after.success == pytest.approx(before.success)
+        assert after.updated == pytest.approx(70.0)
+        # loading re-exported the gauges for the restarted process
+        label = peer_label(PEER)
+        tput = obs_metrics.registry().get(
+            "bkw_peer_throughput_bytes_per_second")
+        assert tput.value(peer=label) == pytest.approx(
+            before.throughput_bps)
+        # and the bank keeps evolving from the persisted state
+        evolved = ps2.observe(_ok(size=1 << 20, send_s=0.1), now=80.0)
+        assert evolved.samples == 4
+        row = store2.get_peer_stats(PEER)
+        assert row is not None and row.samples == 4
+    finally:
+        store2.close()
+
+
+def test_row_round_trip_and_upsert(tmp_path):
+    store = Store(directory=tmp_path / "cfg", data_base=tmp_path / "data")
+    try:
+        assert store.get_peer_stats(PEER) is None
+        assert store.all_peer_stats() == []
+        store.put_peer_stats(PeerStatsRow(
+            peer=PEER, throughput_bps=1e6, latency_s=0.2,
+            success=0.9, samples=5, updated=123.0))
+        store.put_peer_stats(PeerStatsRow(
+            peer=PEER, throughput_bps=2e6, latency_s=0.1,
+            success=0.95, samples=6, updated=124.0))
+        rows = store.all_peer_stats()
+        assert len(rows) == 1  # upsert, not append
+        assert rows[0].throughput_bps == 2e6
+        assert rows[0].samples == 6
+    finally:
+        store.close()
+
+
+def test_default_alpha_comes_from_defaults():
+    assert PeerStats().alpha == defaults.PEER_STATS_ALPHA
+    assert 0.0 < defaults.PEER_STATS_ALPHA < 1.0

@@ -1,0 +1,150 @@
+"""Timeline export (obs/timeline.py): spans -> Chrome trace events.
+
+A golden test pins the exact event list for a small synthetic journal
+(span tree with close-time/duration math, instants, process metadata,
+track assignment), and a cross-process test drives the REAL span
+machinery — two Journal files, a trace id carried from the sender's
+span into the receiver's ``trace.bind`` the way wire envelopes carry it
+— and requires the merged document to correlate both processes under
+the one trace id.
+"""
+
+import json
+
+from backuwup_tpu.obs import journal as obs_journal
+from backuwup_tpu.obs import timeline
+from backuwup_tpu.obs import trace
+
+# Synthetic journal records: span lines record CLOSE time + dur_s, the
+# way obs/trace.py writes them.
+SENDER = [
+    {"ts": 12.0, "kind": "span", "name": "engine.backup",
+     "trace_id": "t1", "span_id": "s1", "parent_id": None, "dur_s": 2.0},
+    {"ts": 10.5, "kind": "span", "name": "packer.manifest_many",
+     "trace_id": "t1", "span_id": "s2", "parent_id": "s1", "dur_s": 0.5},
+    {"ts": 11.0, "kind": "backup_started", "trace_id": "t1",
+     "snapshot": "abcd"},
+    {"ts": 11.5, "kind": "span", "name": "unrelated.trace",
+     "trace_id": "t2", "span_id": "s9", "parent_id": None, "dur_s": 0.1},
+    {"ts": 11.6, "kind": "checkpoint"},  # no trace id: track 0
+]
+RECEIVER = [
+    {"ts": 11.2, "kind": "span", "name": "receiver.store",
+     "trace_id": "t1", "span_id": "r1", "parent_id": None, "dur_s": 0.2},
+]
+
+
+def test_golden_trace_events():
+    events = timeline.to_trace_events(
+        [("sender", SENDER), ("receiver", RECEIVER)])
+    assert events == [
+        # metadata rows sort first
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "args": {"name": "sender"}},
+        {"name": "process_name", "ph": "M", "pid": 2, "tid": 0,
+         "args": {"name": "receiver"}},
+        # start = close - dur; "t1" is the sender's first track
+        {"name": "engine.backup", "cat": "span", "ph": "X",
+         "ts": 10_000_000, "dur": 2_000_000, "pid": 1, "tid": 1,
+         "args": {"trace_id": "t1", "span_id": "s1", "parent_id": None}},
+        {"name": "packer.manifest_many", "cat": "span", "ph": "X",
+         "ts": 10_000_000, "dur": 500_000, "pid": 1, "tid": 1,
+         "args": {"trace_id": "t1", "span_id": "s2", "parent_id": "s1"}},
+        {"name": "backup_started", "cat": "journal", "ph": "i", "s": "t",
+         "ts": 11_000_000, "pid": 1, "tid": 1,
+         "args": {"trace_id": "t1", "snapshot": "abcd"}},
+        {"name": "receiver.store", "cat": "span", "ph": "X",
+         "ts": 11_000_000, "dur": 200_000, "pid": 2, "tid": 1,
+         "args": {"trace_id": "t1", "span_id": "r1", "parent_id": None}},
+        # second distinct trace in the sender journal: second track
+        {"name": "unrelated.trace", "cat": "span", "ph": "X",
+         "ts": 11_400_000, "dur": 100_000, "pid": 1, "tid": 2,
+         "args": {"trace_id": "t2", "span_id": "s9", "parent_id": None}},
+        # traceless instant lands on track 0
+        {"name": "checkpoint", "cat": "journal", "ph": "i", "s": "t",
+         "ts": 11_600_000, "pid": 1, "tid": 0, "args": {}},
+    ]
+
+
+def test_trace_id_filter_cuts_to_one_backup():
+    events = timeline.to_trace_events(
+        [("sender", SENDER), ("receiver", RECEIVER)], trace_id="t1")
+    names = [e["name"] for e in events if e["ph"] != "M"]
+    # t2 span and the traceless instant are gone; t1 survives everywhere
+    assert "unrelated.trace" not in names
+    assert "checkpoint" not in names
+    assert set(names) == {"engine.backup", "packer.manifest_many",
+                          "backup_started", "receiver.store"}
+    assert all(e["args"]["trace_id"] == "t1"
+               for e in events if e["ph"] == "X")
+
+
+def test_zero_duration_span_still_renders():
+    events = timeline.to_trace_events(
+        [("j", [{"ts": 5.0, "kind": "span", "name": "tiny",
+                 "trace_id": "t", "span_id": "s", "parent_id": None,
+                 "dur_s": 0.0}])])
+    (span,) = [e for e in events if e["ph"] == "X"]
+    assert span["dur"] == 1  # Perfetto drops dur=0 slices
+
+
+def test_journal_records_skips_torn_lines(tmp_path):
+    p = tmp_path / "j.jsonl"
+    p.write_text('{"ts": 1.0, "kind": "ok"}\n'
+                 '{"ts": 2.0, "kind": "torn', encoding="utf-8")
+    recs = timeline.journal_records(p)
+    assert [r["kind"] for r in recs] == ["ok"]
+    assert timeline.journal_records(tmp_path / "missing.jsonl") == []
+
+
+def test_cross_process_merge_by_trace_id(tmp_path):
+    """Two real journals, the trace id carried sender -> receiver via
+    trace.bind exactly as the wire envelope does: the merged timeline
+    must show both processes' spans on the one trace."""
+    sender_path = tmp_path / "sender.jsonl"
+    receiver_path = tmp_path / "receiver.jsonl"
+
+    obs_journal.install(obs_journal.Journal(sender_path))
+    try:
+        with trace.span("engine.backup") as ctx:
+            tid = ctx.trace_id
+            with trace.span("transfer.send"):
+                pass
+    finally:
+        obs_journal.uninstall()
+
+    obs_journal.install(obs_journal.Journal(receiver_path))
+    try:
+        with trace.bind(tid):  # what _verify_body does with the envelope
+            with trace.span("receiver.store"):
+                pass
+    finally:
+        obs_journal.uninstall()
+
+    out = tmp_path / "timeline.json"
+    doc = timeline.export_timeline(
+        [sender_path, receiver_path], out, trace_id=tid,
+        labels=["sender", "receiver"])
+    events = doc["traceEvents"]
+    assert doc["otherData"]["generator"] == "backuwup-tpu obs.timeline"
+
+    spans = {e["name"]: e for e in events if e["ph"] == "X"}
+    assert set(spans) == {"engine.backup", "transfer.send",
+                          "receiver.store"}
+    assert all(e["args"]["trace_id"] == tid for e in spans.values())
+    # the two journals really are two Perfetto processes
+    assert spans["engine.backup"]["pid"] == 1
+    assert spans["transfer.send"]["pid"] == 1
+    assert spans["receiver.store"]["pid"] == 2
+    # child nests inside its parent on the sender timeline (±5 us for
+    # the independent close-timestamp/duration roundings)
+    parent, child = spans["engine.backup"], spans["transfer.send"]
+    assert child["args"]["parent_id"] == parent["args"]["span_id"]
+    assert parent["ts"] <= child["ts"] + 5
+    assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] + 5
+    # labelled process metadata made it through
+    meta = {e["pid"]: e["args"]["name"]
+            for e in events if e["ph"] == "M"}
+    assert meta == {1: "sender", 2: "receiver"}
+    # and the on-disk document reloads identically
+    assert json.loads(out.read_text(encoding="utf-8")) == doc
