@@ -164,7 +164,9 @@ class ClientApp:
 
     async def _accept_peer_data(self, source: bytes, transport) -> None:
         writer = ReceivedFilesWriter(self.store, source)
-        count = await Receiver(transport, writer.sink).run()
+        count = await Receiver(transport, writer.sink,
+                               part_sink=writer.sink_part,
+                               resume_query=writer.resume_offer).run()
         self.messenger.log(
             f"stored {count} files for peer {bytes(source).hex()[:8]}")
 

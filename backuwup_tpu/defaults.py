@@ -91,6 +91,48 @@ TRANSFER_INFLIGHT_BYTE_CAP = 64 * MiB
 PACK_SEAL_WORKERS = 2
 PACK_SEAL_QUEUE_PACKFILES = 2
 
+# --- resumable WAN transfer plane (net/p2p.py send_file, docs/transfer.md) ---
+# Payloads larger than this go out as FILE_PART frames with per-part acks
+# and receiver-side partial persistence, preceded by a RESUME_QUERY so a
+# reconnect continues from the verified offset.  0 disables chunking
+# entirely (every file rides the legacy whole-FILE frame).
+TRANSFER_CHUNK_BYTES = 1 * MiB
+# Reconnect-and-resume attempts after a mid-transfer failure of a chunked
+# send, before the failure surfaces to the scheduler as a failed transfer.
+TRANSFER_RESUME_ATTEMPTS = 2
+# False = reconnect attempts restart from byte zero (no RESUME_QUERY);
+# the bench's restart-from-zero baseline leg, never what production wants.
+TRANSFER_RESUME_ENABLED = True
+# Adaptive per-transfer deadline (replaces the fixed send/ack timeout pair
+# for sized payloads): budget = ACK_TIMEOUT_S + size / floor, where floor
+# is the larger of the assumed minimum link rate and the peer's measured
+# EWMA throughput derated by the safety fraction.  The minimum keeps a
+# never-measured peer from being declared stalled on its first large
+# send; the safety fraction tolerates throughput regressions before the
+# stall detector calls abort-and-resume.
+TRANSFER_MIN_THROUGHPUT_BPS = 256 * KiB
+TRANSFER_DEADLINE_SAFETY = 0.25
+TRANSFER_DEADLINE_CAP_S = 600.0
+
+# --- capacity-aware placement (store.find_peers_with_storage,
+# net/peer_stats.py; docs/transfer.md) ----------------------------------------
+# Peers are ranked by log2-bucketed (EWMA throughput x success ratio) with
+# free space as the tiebreak; a peer needs this many samples before its
+# measurement outranks the neutral prior, so fresh peers stay schedulable.
+PLACEMENT_MIN_SAMPLES = 3
+# Score assumed for unmeasured peers: they sort above measured-slow peers
+# and below measured-fast ones.
+PLACEMENT_NEUTRAL_SCORE_BPS = TRANSFER_MIN_THROUGHPUT_BPS
+# Placement demotion (recoverable; distinct from audit demotion): a peer
+# whose success EWMA sinks below the demote threshold over at least
+# min-samples transfers stops receiving placements until either its
+# success EWMA climbs back over the recovery threshold or the probation
+# window expires.
+PLACEMENT_DEMOTE_SUCCESS = 0.25
+PLACEMENT_RECOVER_SUCCESS = 0.6
+PLACEMENT_DEMOTE_MIN_SAMPLES = 4
+PLACEMENT_PROBATION_S = 600.0
+
 # --- protocol limits (reference shared/src/constants.rs:4-7) ----------------
 MAX_BACKUP_STORAGE_REQUEST_SIZE = 16 * GiB
 BACKUP_REQUEST_EXPIRY_S = 300.0

@@ -47,10 +47,18 @@ from .crypto import KeyManager
 from .erasure import gf_cpu
 from .erasure import stripe as rs_stripe
 from .net.client import NoBackups, ServerClient, ServerError
-from .net.p2p import P2PError, P2PNode, Receiver, RestoreFilesWriter, Transport
+from .net.p2p import (
+    P2PError,
+    P2PNode,
+    Receiver,
+    RestoreFilesWriter,
+    SendProgress,
+    Transport,
+)
 from .net.peer_stats import PeerStats
-from .net.transfer import TransferScheduler
+from .net.transfer import BYTES_RESENT, TransferScheduler
 from .obs import invariants as obs_invariants
+from .obs import journal as obs_journal
 from .obs import metrics as obs_metrics
 from .obs import profile as obs_profile
 from .obs import trace as obs_trace
@@ -511,14 +519,73 @@ class Engine:
                 await self._drop_transport(orch, r.peer_id)
         return sent
 
+    def _peer_throughput(self, peer_id: bytes) -> float:
+        """Measured EWMA throughput hint for adaptive deadlines; 0.0
+        until the peer has enough samples to trust."""
+        est = self.peer_stats.get(peer_id) if self.peer_stats else None
+        if est is None or est.samples < defaults.PLACEMENT_MIN_SAMPLES:
+            return 0.0
+        return est.throughput_bps
+
+    async def _send_resumable(self, orch: Orchestrator, transport,
+                              peer_id: bytes, data: bytes,
+                              file_info: wire.FileInfoKind,
+                              file_id: bytes) -> None:
+        """``send_file`` with the abort-and-resume loop around it.
+
+        A mid-transfer failure (cut link, stalled ack) drops the poisoned
+        transport, redials, and continues the chunked send from the
+        receiver's verified offset — up to TRANSFER_RESUME_ATTEMPTS
+        reconnects before the failure surfaces to the scheduler.  Bytes
+        shipped more than once across attempts are accounted to
+        ``bkw_transfer_bytes_resent_total`` (the wan scenario's budget).
+        """
+        peer_id = bytes(peer_id)
+        tput = self._peer_throughput(peer_id)
+        resume = bool(defaults.TRANSFER_RESUME_ENABLED)
+        attempts = int(defaults.TRANSFER_RESUME_ATTEMPTS)
+        hwm = 0  # high-water wire offset across attempts
+        t = transport
+        for attempt in range(attempts + 1):
+            prog = SendProgress()
+            try:
+                await t.send_file(data, file_info, file_id, resume=resume,
+                                  throughput_bps=tput, progress=prog)
+                BYTES_RESENT.inc(max(0, min(prog.offset, hwm)
+                                     - prog.started))
+                return
+            except P2PError as e:
+                # the overlap between this attempt's shipped range and
+                # anything shipped before is waste the resume plane
+                # failed to avoid
+                BYTES_RESENT.inc(max(0, min(prog.offset, hwm)
+                                     - prog.started))
+                hwm = max(hwm, prog.offset)
+                await self._drop_transport(orch, peer_id)
+                if attempt >= attempts or self.node is None:
+                    raise
+                obs_journal.emit("transfer_resume",
+                                 peer=peer_id.hex()[:16],
+                                 attempt=attempt + 1,
+                                 offset=prog.offset, error=str(e))
+                try:
+                    t = await self.node.connect(
+                        peer_id, wire.RequestType.TRANSPORT, timeout=3.0)
+                except (P2PError, ServerError, OSError,
+                        asyncio.TimeoutError) as e2:
+                    raise P2PError(
+                        f"reconnect for resume failed: {e2}") from e2
+                orch.active_transports[peer_id] = t
+
     def _whole_file_job(self, orch: Orchestrator, transport, peer_id: bytes,
                         pid: bytes, path: Path, size: int):
-        """One scheduled transfer: read off-loop, send, then post-ack
-        bookkeeping.  An OSError on the read is isolated to this transfer
-        (the file is retried next tick), not a peer failure."""
+        """One scheduled transfer: read off-loop, send (resumably), then
+        post-ack bookkeeping.  An OSError on the read is isolated to this
+        transfer (the file is retried next tick), not a peer failure."""
         async def job() -> None:
             data = await self._blocking(path.read_bytes)
-            await transport.send_data(data, wire.FileInfoKind.PACKFILE, pid)
+            await self._send_resumable(orch, transport, peer_id, data,
+                                       wire.FileInfoKind.PACKFILE, pid)
             # delete only after ack (send.rs:277-289)
             await self._blocking(path.unlink)
             self.store.add_peer_transmitted(peer_id, size)
@@ -609,8 +676,8 @@ class Engine:
             pairs = list(zip(missing, conns))
             tasks = [
                 sched.submit(peer_id, len(containers[i]),
-                             self._shard_job(transport, peer_id, pid, i,
-                                             containers[i]),
+                             self._shard_job(orch, transport, peer_id, pid,
+                                             i, containers[i]),
                              label=f"shard:{bytes(pid).hex()[:8]}:{i}")
                 for i, (transport, peer_id, _free) in pairs]
             all_acked = True
@@ -635,12 +702,13 @@ class Engine:
                 leftover.append((pid, path, size))
         return leftover, placed_bytes
 
-    def _shard_job(self, transport, peer_id: bytes, pid: bytes, index: int,
-                   container: bytes):
+    def _shard_job(self, orch: Orchestrator, transport, peer_id: bytes,
+                   pid: bytes, index: int, container: bytes):
         """One scheduled shard transfer + its post-ack bookkeeping."""
         async def job() -> None:
-            await transport.send_data(container, wire.FileInfoKind.SHARD,
-                                      rs_stripe.shard_id(pid, index))
+            await self._send_resumable(orch, transport, peer_id, container,
+                                       wire.FileInfoKind.SHARD,
+                                       rs_stripe.shard_id(pid, index))
             self.store.add_peer_transmitted(peer_id, len(container))
             self.store.record_placement(pid, peer_id, len(container),
                                         shard_index=index)
@@ -677,15 +745,19 @@ class Engine:
                                       exclude: set, min_free: int) -> list:
         """Up to ``need`` transports to DISTINCT peers outside ``exclude``,
         each with ``min_free`` bytes of allowance: reuse actives first,
-        then dial known peers most-free-first (same order the legacy
-        single-peer path uses)."""
+        then dial known peers in measured-capacity order (the same
+        ordering ``find_peers_with_storage`` gives the legacy path)."""
         conns = []
         chosen = set()
+        # capacity demotion applies to active transports too: an open
+        # socket to a measured-flaky peer is not a reason to keep
+        # placing shards on it
+        demoted = self.store.placement_demoted_peers()
         for peer_id, t in list(orch.active_transports.items()):
             if len(conns) >= need:
                 break
             key = bytes(peer_id)
-            if key in exclude or key in chosen:
+            if key in exclude or key in chosen or key in demoted:
                 continue
             peer = self.store.get_peer(key)
             if peer is not None and peer.free_storage >= min_free:
@@ -698,7 +770,8 @@ class Engine:
                     break
                 key = bytes(peer.pubkey)
                 if peer.free_storage < min_free:
-                    continue  # ordered by free space: the rest are smaller
+                    continue  # capacity-ordered now, so keep scanning:
+                    # a later (slower) peer may still have the space
                 if key in orch.active_transports:
                     continue  # already weighed in the reuse pass
                 try:
@@ -766,8 +839,10 @@ class Engine:
         """
         usable = min_free - defaults.PEER_OVERUSE_GRACE // 2
 
+        demoted = self.store.placement_demoted_peers()
         for peer_id, t in list(orch.active_transports.items()):
-            if bytes(peer_id) in self._avoid_peers:
+            if bytes(peer_id) in self._avoid_peers \
+                    or bytes(peer_id) in demoted:
                 await self._drop_transport(orch, peer_id)
                 continue
             peer = self.store.get_peer(peer_id)
@@ -778,7 +853,8 @@ class Engine:
         for peer in self.store.find_peers_with_storage(
                 exclude=self._avoid_peers):
             if peer.free_storage < usable:
-                continue  # ordered by free space: the rest are smaller
+                continue  # capacity-ordered now, so keep scanning:
+                # a later (slower) peer may still have the space
             try:
                 t = await self.node.connect(peer.pubkey,
                                             wire.RequestType.TRANSPORT,
@@ -1143,7 +1219,9 @@ class Engine:
                 t = await self.node.connect(
                     peer_id, wire.RequestType.RESTORE_ALL, timeout=10.0)
                 try:
-                    await Receiver(t, writer.sink).run()
+                    await Receiver(t, writer.sink,
+                                   part_sink=writer.sink_part,
+                                   resume_query=writer.resume_offer).run()
                 finally:
                     await t.close()
             except (P2PError, ServerError, OSError,
@@ -1195,8 +1273,8 @@ class Engine:
                                          pidb, idx, container)
                     tasks.append(sched.submit(
                         peer_id, len(container),
-                        self._repair_shard_job(transport, peer_id, pidb,
-                                               idx, container,
+                        self._repair_shard_job(orch, transport, peer_id,
+                                               pidb, idx, container,
                                                lost_map[idx][0]),
                         label=f"repair:{pidb.hex()[:8]}:{idx}"))
                 placed_here = 0
@@ -1224,14 +1302,16 @@ class Engine:
                 lambda: shutil.rmtree(staging, ignore_errors=True))
         return rebuilt, placed_bytes, unrebuildable
 
-    def _repair_shard_job(self, transport, peer_id: bytes, pidb: bytes,
-                          idx: int, container: bytes, dead_peer: bytes):
+    def _repair_shard_job(self, orch, transport, peer_id: bytes,
+                          pidb: bytes, idx: int, container: bytes,
+                          dead_peer: bytes):
         """One scheduled replacement-shard transfer; on ack the dead row
         retires immediately instead of waiting for the end-of-round
         retirement."""
         async def job() -> None:
-            await transport.send_data(container, wire.FileInfoKind.SHARD,
-                                      rs_stripe.shard_id(pidb, idx))
+            await self._send_resumable(orch, transport, peer_id, container,
+                                       wire.FileInfoKind.SHARD,
+                                       rs_stripe.shard_id(pidb, idx))
             self.store.add_peer_transmitted(peer_id, len(container))
             self.store.record_placement(pidb, peer_id, len(container),
                                         shard_index=idx)
@@ -1331,7 +1411,9 @@ class Engine:
                                         wire.RequestType.RESTORE_ALL,
                                         timeout=10.0)
             try:
-                await Receiver(t, writer.sink).run()
+                await Receiver(t, writer.sink,
+                               part_sink=writer.sink_part,
+                               resume_query=writer.resume_offer).run()
             finally:
                 await t.close()
             completed[peer_id] = True

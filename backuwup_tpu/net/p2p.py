@@ -22,10 +22,11 @@ over WebSocket, end-to-end authenticated:
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import time
 from pathlib import Path
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -36,9 +37,28 @@ except ModuleNotFoundError:  # containers without the wheel: aiohttp shim
 
 from .. import defaults, wire
 from ..crypto import KeyManager, verify_signature
+from ..obs import journal as obs_journal
+from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
+from ..ops.blake3_cpu import blake3_many
 from ..store import Store
 from ..utils import faults, retry
+
+_P2P_BYTES = obs_metrics.counter(
+    "bkw_p2p_bytes_sent_total",
+    "Signed frame bytes shipped through the transport send chokepoint")
+_SEQ_BREAKS = obs_metrics.counter(
+    "bkw_p2p_sequence_breaks_total",
+    "Receiver sequence-validation failures (replay protection tripped)")
+_PARTS = obs_metrics.counter(
+    "bkw_transfer_parts_total", "FILE_PART frames acked end-to-end")
+_RESUMES = obs_metrics.counter(
+    "bkw_transfer_resumes_total",
+    "RESUME_OFFER outcomes on chunked sends (resumed / restarted_*)",
+    ("outcome",))
+_STALLS = obs_metrics.counter(
+    "bkw_transfer_stalls_total",
+    "Adaptive-deadline expiries (transfer aborted toward resume)")
 
 PURPOSE_TRANSPORT = wire.RequestType.TRANSPORT
 PURPOSE_RESTORE = wire.RequestType.RESTORE_ALL
@@ -60,6 +80,59 @@ def obfuscate(data: bytes, key: bytes) -> bytes:
     k = np.frombuffer(bytes(key) * (len(arr) // 4), dtype=np.uint8)
     out = (arr ^ k).tobytes()
     return out[:len(data)]
+
+
+def adaptive_deadline(size: int, throughput_bps: float = 0.0) -> float:
+    """Per-transfer ack deadline scaled to payload size (docs/transfer.md).
+
+    Replaces the fixed ``ACK_TIMEOUT_S`` for sized payloads: the budget is
+    the ack floor plus the seconds the payload needs at the slower of the
+    assumed minimum link rate and the peer's measured EWMA throughput
+    derated by the safety fraction — so a large file on a slow-but-alive
+    link is not declared dead, while a genuine stall still trips fast.
+    """
+    floor = float(defaults.TRANSFER_MIN_THROUGHPUT_BPS)
+    if throughput_bps > 0.0:
+        floor = max(floor, throughput_bps * defaults.TRANSFER_DEADLINE_SAFETY)
+    return min(defaults.ACK_TIMEOUT_S + size / max(floor, 1.0),
+               defaults.TRANSFER_DEADLINE_CAP_S)
+
+
+class SendProgress:
+    """Wire-progress of one ``send_file`` attempt, for resume accounting:
+    ``started`` is the offset the attempt resumed from, ``offset`` the
+    high-water byte that has hit the wire (updated before each part's ack,
+    so a cut mid-ack still counts its shipped bytes)."""
+
+    def __init__(self) -> None:
+        self.started = 0
+        self.offset = 0
+
+
+def validate_resume_offer(offer: wire.P2PBody, data: bytes, digest: bytes,
+                          file_id: bytes) -> Tuple[int, str]:
+    """Decide where a chunked send restarts given the receiver's offer.
+
+    Returns ``(start_offset, outcome)``.  A verified prefix resumes
+    (``resumed``); a digest mismatch means the receiver holds a partial of
+    a *different* file version (``restarted_stale``) and a bad prefix
+    digest means its partial is corrupt (``restarted_corrupt``) — both
+    restart from zero, and the receiver discards its partial when part 0
+    arrives.  Never trusts the offer: the whole-file digest is recomputed
+    sender-side and the final assembled file is verified receiver-side.
+    """
+    if offer.kind != wire.P2PBodyKind.RESUME_OFFER:
+        raise P2PError("expected a RESUME_OFFER body")
+    if bytes(offer.file_id) != bytes(file_id):
+        raise P2PError("RESUME_OFFER for a different file id")
+    off = int(offer.offset)
+    if off <= 0 or off > len(data):
+        return 0, "cold"
+    if bytes(offer.file_digest) != bytes(digest):
+        return 0, "restarted_stale"
+    if bytes(offer.prefix_digest) != blake3_many([data[:off]])[0]:
+        return 0, "restarted_corrupt"
+    return off, "resumed"
 
 
 class ConnectionRequests:
@@ -152,9 +225,60 @@ class Transport:
             except RuntimeError:
                 pass
 
+    async def _ship(self, raw: bytes, seq: Optional[int] = None,
+                    timeout: Optional[float] = None) -> None:
+        """The single outbound chokepoint: EVERY signed frame leaves
+        through here, so the fault plane's drop/corrupt/latency sites see
+        control frames (audit, resume negotiation) exactly as they see
+        FILE frames — no chaos-immune traffic."""
+        plane = faults.PLANE
+        if plane is not None:  # chaos hook; inert in production (PLANE=None)
+            action = await plane.on_send(self.peer_id)
+            if action == faults.ACT_DROP:
+                await self.close()
+                if seq is not None:
+                    self._acks.pop(seq, None)
+                raise P2PError("injected connection drop"
+                               + (f" at seq {seq}" if seq is not None else ""))
+            if action == faults.ACT_CORRUPT:
+                raw = plane.corrupt(raw, self.peer_id)
+        _P2P_BYTES.inc(len(raw))
+        try:
+            await asyncio.wait_for(
+                self.ws.send(raw),
+                defaults.PACKFILE_SEND_TIMEOUT_S if timeout is None
+                else timeout)
+        except (asyncio.TimeoutError, websockets.ConnectionClosed) as e:
+            raise P2PError(f"send failed: {e}") from e
+
+    async def _send_acked(self, body: wire.P2PBody, seq: int,
+                          deadline: float) -> None:
+        """Ship one seq-carrying frame and wait for its signed ack under
+        the adaptive deadline; a deadline expiry is counted as a stall
+        (the caller aborts-and-resumes rather than restarting)."""
+        ev = asyncio.Event()
+        self._acks[seq] = ev
+        raw = _sign_body(self.keys, body)
+        try:
+            await self._ship(raw, seq=seq,
+                             timeout=max(defaults.PACKFILE_SEND_TIMEOUT_S,
+                                         deadline))
+            try:
+                await asyncio.wait_for(ev.wait(), deadline)
+            except asyncio.TimeoutError as e:
+                _STALLS.inc()
+                raise P2PError(
+                    f"ack stalled for seq {seq}"
+                    f" after {deadline:.1f}s") from e
+        finally:
+            self._acks.pop(seq, None)
+
     async def send_data(self, data: bytes, file_info: wire.FileInfoKind,
-                        file_id: bytes) -> None:
-        """Send one file; waits for the signed ack (transport.rs:111-132)."""
+                        file_id: bytes, throughput_bps: float = 0.0) -> None:
+        """Send one file as a single FILE frame; waits for the signed ack
+        (transport.rs:111-132).  The ack deadline scales with payload size
+        so a large file on a slow link is distinguishable from a dead
+        peer even on this legacy non-chunked path."""
         seq = self.seq
         self.seq += 1
         body = wire.P2PBody(
@@ -162,35 +286,96 @@ class Transport:
             header=wire.P2PHeader(sequence_number=seq,
                                   session_nonce=self.session_nonce),
             file_info=file_info, file_id=bytes(file_id), data=bytes(data))
-        ev = asyncio.Event()
-        self._acks[seq] = ev
-        raw = _sign_body(self.keys, body)
-        plane = faults.PLANE
-        if plane is not None:  # chaos hook; inert in production (PLANE=None)
-            action = await plane.on_send(self.peer_id)
-            if action == faults.ACT_DROP:
-                await self.close()
-                self._acks.pop(seq, None)
-                raise P2PError(f"injected connection drop at seq {seq}")
-            if action == faults.ACT_CORRUPT:
-                raw = plane.corrupt(raw, self.peer_id)
-        try:
-            await asyncio.wait_for(self.ws.send(raw),
-                                   defaults.PACKFILE_SEND_TIMEOUT_S)
-            await asyncio.wait_for(ev.wait(), defaults.ACK_TIMEOUT_S)
-        except (asyncio.TimeoutError, websockets.ConnectionClosed) as e:
-            raise P2PError(f"send/ack failed for seq {seq}: {e}") from e
-        finally:
-            self._acks.pop(seq, None)
+        await self._send_acked(
+            body, seq, adaptive_deadline(len(data), throughput_bps))
+
+    async def send_file(self, data: bytes, file_info: wire.FileInfoKind,
+                        file_id: bytes, *, resume: bool = True,
+                        throughput_bps: float = 0.0,
+                        progress: Optional[SendProgress] = None) -> None:
+        """Send one file, chunked into resumable FILE_PART frames when it
+        exceeds ``TRANSFER_CHUNK_BYTES`` (else the legacy FILE frame).
+
+        A chunked send first asks the receiver how much of ``file_id`` it
+        already holds (RESUME_QUERY/RESUME_OFFER) and continues from the
+        verified offset; the receiver checks the assembled file against
+        the whole-file digest before the final part's ack.
+        """
+        data = bytes(data)
+        chunk = int(defaults.TRANSFER_CHUNK_BYTES)
+        if chunk <= 0 or len(data) <= chunk:
+            if progress is not None:
+                progress.offset = len(data)  # all-or-nothing frame
+            await self.send_data(data, file_info, file_id,
+                                 throughput_bps=throughput_bps)
+            return
+        loop = asyncio.get_running_loop()
+        digest = await loop.run_in_executor(
+            None, lambda: blake3_many([data])[0])
+        start = 0
+        if resume:
+            start = await self._negotiate_resume(data, file_info, file_id,
+                                                 digest, throughput_bps)
+        if progress is not None:
+            progress.started = start
+            progress.offset = start
+        off = start
+        while off < len(data):
+            part = data[off:off + chunk]
+            plane = faults.PLANE
+            if plane is not None:
+                if plane.on_send_part(self.peer_id, off,
+                                      len(part)) == faults.ACT_DROP:
+                    await self.close()
+                    raise P2PError(
+                        f"injected mid-transfer cut at offset {off}")
+            seq = self.seq
+            self.seq += 1
+            body = wire.P2PBody(
+                kind=wire.P2PBodyKind.FILE_PART,
+                header=wire.P2PHeader(sequence_number=seq,
+                                      session_nonce=self.session_nonce),
+                file_info=file_info, file_id=bytes(file_id), data=part,
+                offset=off, total_size=len(data), file_digest=digest)
+            if progress is not None:
+                progress.offset = off + len(part)  # on the wire before ack
+            await self._send_acked(
+                body, seq, adaptive_deadline(len(part), throughput_bps))
+            _PARTS.inc()
+            off += len(part)
+
+    async def _negotiate_resume(self, data: bytes,
+                                file_info: wire.FileInfoKind,
+                                file_id: bytes, digest: bytes,
+                                throughput_bps: float) -> int:
+        """RESUME_QUERY -> RESUME_OFFER round trip; returns the verified
+        offset to continue from (0 = cold or restart)."""
+        seq = self.seq
+        self.seq += 1
+        query = wire.P2PBody(
+            kind=wire.P2PBodyKind.RESUME_QUERY,
+            header=wire.P2PHeader(sequence_number=seq,
+                                  session_nonce=self.session_nonce),
+            file_info=file_info, file_id=bytes(file_id))
+        await self._ship(_sign_body(self.keys, query))
+        offer = await self.recv_body(adaptive_deadline(0, throughput_bps))
+        loop = asyncio.get_running_loop()
+        start, outcome = await loop.run_in_executor(
+            None, lambda: validate_resume_offer(offer, data, digest,
+                                                file_id))
+        if int(offer.offset) > 0:
+            _RESUMES.inc(outcome=outcome)
+            obs_journal.emit("transfer_resume_offer",
+                             peer=self.peer_id.hex()[:16], outcome=outcome,
+                             offered=int(offer.offset), start=start)
+        return start
 
     async def send_body(self, body: wire.P2PBody) -> None:
-        """Fire one signed non-FILE body (audit challenge/proof exchange —
-        correlation is by echoed sequence number, not per-frame acks)."""
-        try:
-            await asyncio.wait_for(self.ws.send(_sign_body(self.keys, body)),
-                                   defaults.PACKFILE_SEND_TIMEOUT_S)
-        except (asyncio.TimeoutError, websockets.ConnectionClosed) as e:
-            raise P2PError(f"send failed: {e}") from e
+        """Fire one signed non-FILE body (audit challenge/proof exchange,
+        resume offers — correlation is by echoed sequence number, not
+        per-frame acks).  Routed through the fault chokepoint like every
+        other outbound frame."""
+        await self._ship(_sign_body(self.keys, body))
 
     async def recv_body(self, timeout: float) -> wire.P2PBody:
         """Next verified non-ACK body from the peer (None sentinel on close
@@ -215,34 +400,69 @@ class Transport:
 class Receiver:
     """Receive side: strict-sequence validation + signed acks (receive.rs).
 
-    ``sink(file_info, file_id, data)`` persists one file; the loop ends when
-    the peer closes the socket.
+    ``sink(file_info, file_id, data)`` persists one whole file;
+    ``part_sink(file_info, file_id, data, offset, total, digest)`` stages
+    one FILE_PART (returning True when the file completed) and
+    ``resume_query(file_info, file_id)`` answers RESUME_QUERY with
+    ``(offset, digest, prefix_digest)`` — both default to None for legacy
+    callers, which then reject chunked traffic.  The loop ends when the
+    peer closes the socket.
     """
 
     def __init__(self, transport: Transport, sink: Callable,
-                 first_seq: int = 1):
+                 first_seq: int = 1, part_sink: Optional[Callable] = None,
+                 resume_query: Optional[Callable] = None):
         self.t = transport
         self.sink = sink
+        self.part_sink = part_sink
+        self.resume_query = resume_query
         self.expected_seq = first_seq
 
     async def run(self) -> int:
-        """Returns the number of files received."""
+        """Returns the number of files received (completed, not parts)."""
         count = 0
         while True:
             body = await self.t._recv_queue.get()
             if body is None:
                 return count
-            if body.kind != wire.P2PBodyKind.FILE:
+            if body.kind not in (wire.P2PBodyKind.FILE,
+                                 wire.P2PBodyKind.FILE_PART,
+                                 wire.P2PBodyKind.RESUME_QUERY):
                 continue
             if body.header.sequence_number != self.expected_seq:
+                # replay protection tripped: surface it (counter +
+                # journal) and close the transport cleanly before
+                # erroring out of the serve loop — a poisoned session
+                # must not linger half-open
+                _SEQ_BREAKS.inc()
+                obs_journal.emit(
+                    "p2p_sequence_break",
+                    peer=self.t.peer_id.hex()[:16],
+                    got=int(body.header.sequence_number),
+                    expected=int(self.expected_seq))
+                await self.t.close()
                 raise P2PError(
                     f"sequence break: got {body.header.sequence_number}, "
                     f"expected {self.expected_seq} (replay protection)")
+            if body.kind == wire.P2PBodyKind.RESUME_QUERY:
+                await self._answer_resume_query(body)
+                self.expected_seq += 1
+                continue
             # adopt the sender's trace id so this store joins its pack/
             # transfer spans in the journal (the acceptance chain)
             with obs_trace.bind(getattr(body, "trace_id", None)), \
                     obs_trace.span("receiver.store"):
-                await self.sink(body.file_info, body.file_id, body.data)
+                if body.kind == wire.P2PBodyKind.FILE_PART:
+                    if self.part_sink is None:
+                        raise P2PError(
+                            "peer sent FILE_PART but this receiver does"
+                            " not support chunked transfer")
+                    completed = await self.part_sink(
+                        body.file_info, body.file_id, body.data,
+                        body.offset, body.total_size, body.file_digest)
+                else:
+                    await self.sink(body.file_info, body.file_id, body.data)
+                    completed = True
             plane = faults.PLANE
             if plane is not None \
                     and plane.withhold_ack_now(self.t.peer_id):
@@ -257,10 +477,146 @@ class Receiver:
                 acked_sequence=self.expected_seq)
             await self.t.ws.send(_sign_body(self.t.keys, ack))
             self.expected_seq += 1
-            count += 1
+            if completed:
+                count += 1
+
+    async def _answer_resume_query(self, body: wire.P2PBody) -> None:
+        """RESUME_OFFER echoing the query's sequence number (the PROOF
+        pattern: correlation by echoed seq, no ack)."""
+        offset, digest, prefix = 0, b"", b""
+        if self.resume_query is not None:
+            offset, digest, prefix = await self.resume_query(
+                body.file_info, body.file_id)
+        reply = wire.P2PBody(
+            kind=wire.P2PBodyKind.RESUME_OFFER,
+            header=wire.P2PHeader(
+                sequence_number=body.header.sequence_number,
+                session_nonce=self.t.session_nonce),
+            file_id=bytes(body.file_id), offset=int(offset),
+            file_digest=bytes(digest), prefix_digest=bytes(prefix))
+        await self.t.send_body(reply)
 
 
-class ReceivedFilesWriter:
+class PartialStore:
+    """Receiver-side staging for chunked transfers (docs/transfer.md).
+
+    One in-flight file is a ``<file_id hex>.bin`` byte prefix plus a
+    ``.json`` meta record (total size, whole-file digest, file kind)
+    under the writer's ``partial/`` subtree.  All methods are synchronous
+    disk work — callers run them in an executor.  Invariants:
+
+    * parts append strictly contiguously; a gap is a protocol error;
+    * part 0 always truncates: a sender that restarted from zero (stale
+      or corrupt partial) implicitly discards the old bytes;
+    * the assembled file must match the whole-file BLAKE3 before it is
+      handed to the real sink — a corrupted partial is discarded, never
+      acked, never resumed.
+    """
+
+    def __init__(self, base: Path):
+        self.base = Path(base)
+
+    def _paths(self, file_id: bytes) -> Tuple[Path, Path]:
+        stem = bytes(file_id).hex()
+        return self.base / f"{stem}.bin", self.base / f"{stem}.json"
+
+    def query(self, file_id: bytes) -> Tuple[int, bytes, bytes]:
+        """(held bytes, whole-file digest, prefix digest) for RESUME_OFFER;
+        (0, b"", b"") when nothing usable is held."""
+        bin_p, meta_p = self._paths(file_id)
+        if not bin_p.exists() or not meta_p.exists():
+            return 0, b"", b""
+        try:
+            meta = json.loads(meta_p.read_text())
+            digest = bytes.fromhex(meta["digest"])
+            held = bin_p.read_bytes()
+        except (KeyError, ValueError, OSError):
+            self.discard(file_id)
+            return 0, b"", b""
+        if not held:
+            return 0, b"", b""
+        return len(held), digest, blake3_many([held])[0]
+
+    def append(self, file_info: wire.FileInfoKind, file_id: bytes,
+               offset: int, total: int, digest: bytes,
+               data: bytes) -> Optional[bytes]:
+        """Stage one part; returns the assembled, digest-verified bytes
+        when the file completed, else None."""
+        bin_p, meta_p = self._paths(file_id)
+        offset, total = int(offset), int(total)
+        if offset == 0:
+            self.base.mkdir(parents=True, exist_ok=True)
+            meta_p.write_text(json.dumps(
+                {"total": total, "digest": bytes(digest).hex(),
+                 "file_info": int(file_info)}, sort_keys=True))
+            bin_p.write_bytes(bytes(data))
+        else:
+            if not bin_p.exists() or not meta_p.exists():
+                raise P2PError("FILE_PART continues an unknown partial")
+            meta = json.loads(meta_p.read_text())
+            if meta.get("digest") != bytes(digest).hex() \
+                    or int(meta.get("total", -1)) != total:
+                self.discard(file_id)
+                raise P2PError("FILE_PART metadata mismatch;"
+                               " partial discarded")
+            held = bin_p.stat().st_size
+            if offset != held:
+                raise P2PError(f"non-contiguous FILE_PART: offset {offset},"
+                               f" held {held}")
+            with bin_p.open("ab") as f:
+                f.write(bytes(data))
+        held = bin_p.stat().st_size
+        if held < total:
+            return None
+        raw = bin_p.read_bytes()
+        if held > total or blake3_many([raw])[0] != bytes(digest):
+            self.discard(file_id)
+            raise P2PError("assembled file digest mismatch;"
+                           " partial discarded")
+        self.discard(file_id)
+        return raw
+
+    def discard(self, file_id: bytes) -> None:
+        for p in self._paths(file_id):
+            try:
+                p.unlink()
+            except OSError:
+                pass
+
+
+class _ResumableSinkMixin:
+    """Chunked-transfer entry points riding on a writer's ``partials``
+    (a :class:`PartialStore`) and whole-file ``sink``; wired into
+    :class:`Receiver` as ``part_sink``/``resume_query``."""
+
+    def _check_part_admission(self, file_info: wire.FileInfoKind,
+                              file_id: bytes, total: int) -> None:
+        """Veto hook before part 0 burns disk (quota, etc.)."""
+
+    async def sink_part(self, file_info: wire.FileInfoKind, file_id: bytes,
+                        data: bytes, offset: int, total: int,
+                        digest: bytes) -> bool:
+        loop = asyncio.get_running_loop()
+
+        def stage():
+            if int(offset) == 0:
+                self._check_part_admission(file_info, file_id, int(total))
+            return self.partials.append(file_info, file_id, offset, total,
+                                        digest, data)
+
+        raw = await loop.run_in_executor(None, stage)
+        if raw is None:
+            return False
+        await self.sink(file_info, file_id, raw)
+        return True
+
+    async def resume_offer(self, file_info: wire.FileInfoKind,
+                           file_id: bytes) -> Tuple[int, bytes, bytes]:
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.partials.query(file_id))
+
+
+class ReceivedFilesWriter(_ResumableSinkMixin):
     """Store a peer's packfiles/indexes, obfuscated + quota-enforced
     (received_files_writer.rs)."""
 
@@ -268,6 +624,7 @@ class ReceivedFilesWriter:
         self.store = store
         self.peer_id = bytes(peer_id)
         self.dir = store.received_dir(peer_id)
+        self.partials = PartialStore(self.dir / "partial")
         key = store.get_obfuscation_key()
         if key is None:
             raise P2PError("obfuscation key not initialized")
@@ -279,16 +636,29 @@ class ReceivedFilesWriter:
         received = peer.bytes_received if peer else 0
         return negotiated - received + defaults.PEER_OVERUSE_GRACE
 
-    async def sink(self, file_info: wire.FileInfoKind, file_id: bytes,
-                   data: bytes) -> None:
+    def _dest(self, file_info: wire.FileInfoKind, file_id: bytes) -> Path:
         if file_info == wire.FileInfoKind.INDEX:
             sub = "index"
         elif file_info == wire.FileInfoKind.SHARD:
             sub = "shard"  # file_id is the 13-byte shard id
         else:
             sub = "pack"
-        d = self.dir / sub
-        path = d / bytes(file_id).hex()
+        return self.dir / sub / bytes(file_id).hex()
+
+    def _check_part_admission(self, file_info: wire.FileInfoKind,
+                              file_id: bytes, total: int) -> None:
+        # refuse a chunked transfer up front when the whole file could
+        # never fit the quota — don't burn disk on a doomed partial
+        # (idempotent re-sends of an already-stored file are exempt:
+        # the final sink acks those without re-counting)
+        if not self._dest(file_info, file_id).exists() \
+                and total > self._quota_left():
+            raise P2PError("peer exceeded negotiated storage quota")
+
+    async def sink(self, file_info: wire.FileInfoKind, file_id: bytes,
+                   data: bytes) -> None:
+        path = self._dest(file_info, file_id)
+        d = path.parent
         loop = asyncio.get_running_loop()
 
         def persist() -> bool:
@@ -331,7 +701,7 @@ class ReceivedFilesWriter:
                                                              self.key)
 
 
-class RestoreFilesWriter:
+class RestoreFilesWriter(_ResumableSinkMixin):
     """Save own packfiles/shards coming back from a peer during restore
     (restore_files_writer.rs).  ``base`` overrides the destination tree —
     sourceless shard repair stages its survivor fetches in a scratch dir
@@ -339,6 +709,7 @@ class RestoreFilesWriter:
 
     def __init__(self, store: Store, base: Optional[object] = None):
         self.dir = Path(base) if base is not None else store.restore_dir()
+        self.partials = PartialStore(self.dir / "partial")
         self.files = 0
 
     async def sink(self, file_info: wire.FileInfoKind, file_id: bytes,
@@ -393,6 +764,10 @@ class P2PNode:
                                   or plane.is_dead(self.keys.client_id)):
             # fail fast, exactly like a dial to a vanished host
             raise P2PError("injected: peer is dead")
+        if plane is not None and plane.flaky_reconnect(peer_id):
+            # the residential-NAT reconnect lottery: this dial attempt is
+            # simply refused; the caller's resume loop retries
+            raise P2PError("injected: flaky reconnect refused dial")
         nonce = self.requests.add(peer_id, purpose)
         q = self._finalize_waiters.setdefault(peer_id, asyncio.Queue())
         await self.server.p2p_connection_begin(peer_id, nonce)
@@ -500,7 +875,9 @@ class P2PNode:
         writer = ReceivedFilesWriter(self.store, peer_id)
         sent = 0
         for kind, file_id, data in writer.iter_stored():
-            await transport.send_data(data, kind, file_id)
+            # chunked when large: a restore over a flaky WAN link resumes
+            # instead of restarting (the puller passes a part-capable sink)
+            await transport.send_file(data, kind, file_id)
             sent += 1
         return sent
 

@@ -27,6 +27,7 @@ from dataclasses import dataclass, replace
 from typing import Dict, List, Optional
 
 from .. import defaults
+from ..obs import journal as obs_journal
 from ..obs import metrics as obs_metrics
 from ..store import PeerStatsRow
 
@@ -54,6 +55,10 @@ _SEND_SECONDS = obs_metrics.histogram(
     "bkw_peer_transfer_send_seconds",
     "Wire send+ack seconds per peer",
     labelnames=("peer",))
+_DEMOTIONS = obs_metrics.counter(
+    "bkw_placement_demotions_total",
+    "Placement-demotion transitions (capacity-based, not audit)",
+    labelnames=("action",))
 
 
 def peer_label(peer_id: bytes) -> str:
@@ -86,6 +91,7 @@ class PeerStats:
         self.alpha = defaults.PEER_STATS_ALPHA if alpha is None else alpha
         self._lock = threading.Lock()
         self._est: Dict[bytes, PeerEstimate] = {}
+        self._demoted: set = set()
         if store is not None:
             for row in store.all_peer_stats():
                 est = PeerEstimate(
@@ -94,6 +100,8 @@ class PeerStats:
                     latency_s=row.latency_s, success=row.success,
                     samples=row.samples, updated=row.updated)
                 self._est[est.peer] = est
+                if row.placement_demoted:
+                    self._demoted.add(est.peer)
                 self._export(est)
 
     def _export(self, est: PeerEstimate) -> None:
@@ -143,7 +151,38 @@ class PeerStats:
                         samples=est.samples, updated=est.updated))
                 except Exception:
                     pass  # telemetry must never fail a transfer
+            self._update_demotion(est, now)
             return est
+
+    def _update_demotion(self, est: PeerEstimate, now: float) -> None:
+        """Capacity-based placement demotion/recovery (holds _lock).
+
+        Persistently flaky peers (success EWMA under the demote floor
+        after enough samples) stop receiving NEW placements; a run of
+        successes — or, lazily, the probation window in
+        ``Store.placement_demoted_peers`` — recovers them.  Never touches
+        the audit ledger: proven data loss is a different, harsher state.
+        """
+        if est.samples < defaults.PLACEMENT_DEMOTE_MIN_SAMPLES:
+            return
+        demoted = est.peer in self._demoted
+        if not demoted and est.success < defaults.PLACEMENT_DEMOTE_SUCCESS:
+            self._demoted.add(est.peer)
+            self._flip_demotion(est.peer, True, now, "demote")
+        elif demoted and est.success >= defaults.PLACEMENT_RECOVER_SUCCESS:
+            self._demoted.discard(est.peer)
+            self._flip_demotion(est.peer, False, now, "recover")
+
+    def _flip_demotion(self, peer: bytes, demoted: bool, now: float,
+                       action: str) -> None:
+        _DEMOTIONS.inc(action=action)
+        obs_journal.emit("placement_demotion", peer=peer_label(peer),
+                         action=action)
+        if self.store is not None:
+            try:
+                self.store.set_placement_demoted(peer, demoted, now=now)
+            except Exception:
+                pass  # telemetry must never fail a transfer
 
     def get(self, peer_id: bytes) -> Optional[PeerEstimate]:
         with self._lock:

@@ -52,6 +52,13 @@ _TRANSFERS = obs_metrics.counter(
     "bkw_transfers_total", "Completed transfers by outcome", ("outcome",))
 _BYTES_SENT = obs_metrics.counter(
     "bkw_transfer_bytes_total", "Payload bytes successfully transferred")
+#: resume-plane waste gauge: payload bytes shipped more than once because
+#: a transfer was cut and continued (engine._send_resumable accounts the
+#: overlap between attempts).  The wan scenario gates on this staying
+#: under budget — resume means re-sending the tail, not the file.
+BYTES_RESENT = obs_metrics.counter(
+    "bkw_transfer_bytes_resent_total",
+    "Payload bytes re-sent across resume attempts")
 _INFLIGHT = obs_metrics.gauge(
     "bkw_transfer_inflight", "Transfers currently admitted")
 _INFLIGHT_BYTES = obs_metrics.gauge(

@@ -26,6 +26,10 @@ through a scripted sequence of timed phases:
                EngineError until everything completes
 ``restore``    restore to a fresh directory and verify byte-for-byte
                against the source tree digest
+``wan``        WAN-grade transfer conditions: chunked sends with armed
+               mid-transfer cuts that force byte-range resumes, peer
+               stats seeded so capacity-aware placement avoids the
+               placement-demoted slow holder, and probation recovery
 =============  ============================================================
 
 Everything is seeded (fault plane, corpus bytes, victim choice), so a
@@ -54,6 +58,7 @@ from ..obs import invariants as obs_invariants
 from ..obs import metrics as obs_metrics
 from ..ops.backend import ChunkerBackend, CpuBackend
 from ..ops.gear import CDCParams
+from ..store import PeerStatsRow
 from ..utils import faults
 from . import scorecard as sc
 
@@ -89,6 +94,10 @@ class ScenarioSpec:
     corpus_file_bytes: int = 24 * 1024
     packfile_target: int = 64 * 1024
     chunk_desired: int = 4096
+    #: 0 keeps defaults.TRANSFER_CHUNK_BYTES (1 MiB — every loopback
+    #: payload rides the legacy single-frame path); the wan scenario
+    #: shrinks it so shards span several FILE_PART frames
+    chunk_bytes: int = 0
     sample_interval_s: float = 0.1
     expect_violation: bool = False
     expect_final_status: str = "ok"
@@ -152,9 +161,12 @@ class ScenarioHarness:
         spec = self.spec
         self._saved = {k: getattr(defaults, k) for k in _PATCH}
         self._saved["PACKFILE_TARGET_SIZE"] = defaults.PACKFILE_TARGET_SIZE
+        self._saved["TRANSFER_CHUNK_BYTES"] = defaults.TRANSFER_CHUNK_BYTES
         for k, v in _PATCH.items():
             setattr(defaults, k, v)
         defaults.PACKFILE_TARGET_SIZE = spec.packfile_target
+        if spec.chunk_bytes > 0:
+            defaults.TRANSFER_CHUNK_BYTES = spec.chunk_bytes
         self.plane = faults.install(faults.FaultPlane(seed=spec.seed))
         if self.backend is None:
             self.backend = CpuBackend(
@@ -425,6 +437,53 @@ class ScenarioHarness:
         else:
             self.facts["restore_verified"] &= ok
 
+    async def _phase_wan(self, ph: Phase) -> None:
+        """WAN conditions over the chunked transfer plane.  Peer stats
+        are seeded so one holder measures slow/flaky and starts
+        placement-demoted: capacity-aware placement must stripe onto the
+        fast set only.  Every fast holder gets two armed exact-offset
+        cuts, so the backup's shard sends are severed mid-transfer and
+        must resume from the receiver's verified partial rather than
+        restart — the scorecard gates on bkw_transfer_resumes_total and
+        on bkw_transfer_bytes_resent_total staying under budget.
+        Afterwards the slow holder's probation is expired to show the
+        demotion is recoverable, unlike an audit demotion."""
+        if ph.grow:
+            self._grow()
+        now = time.time()
+        fast, slow = self.holders[:-1], self.holders[-1]
+        for h in fast:
+            self.a.store.put_peer_stats(PeerStatsRow(
+                bytes(h.client_id), 50e6, 0.01, 1.0, 10, now))
+        self.a.store.put_peer_stats(PeerStatsRow(
+            bytes(slow.client_id), 2e3, 0.5, 0.1, 10, now))
+        self.a.store.set_placement_demoted(slow.client_id, True, now=now)
+        for h in fast:
+            # one-shot cuts inside the first and second resume attempt's
+            # uncovered ranges (chunk_bytes=4096: parts 2 and 3)
+            self.plane.arm_cut(h.client_id, 6000, 10000)
+        snapshot = await asyncio.wait_for(self.a.backup(), 180)
+        if not snapshot:
+            raise ScenarioError("wan backup returned no snapshot")
+        self.facts["backups"] += 1
+        self.facts["source_digest"] = _tree_digest(self.src)
+        placed = {peer for _, peer, _, _, _ in self.a.store.all_placements()}
+        demoted = self.a.store.placement_demoted_peers()
+        self.facts["wan_placement_ok"] = (
+            bytes(slow.client_id) in demoted
+            and bytes(slow.client_id) not in placed
+            and placed <= {bytes(h.client_id) for h in fast}
+            | {bytes(s.client_id) for s in self.spares})
+        # recoverability: re-demote with a timestamp past the probation
+        # window; the lazy expiry in placement_demoted_peers() must clear
+        # it, putting the peer back in the placement pool
+        self.a.store.set_placement_demoted(
+            slow.client_id, True,
+            now=time.time() - defaults.PLACEMENT_PROBATION_S - 1)
+        self.facts["wan_placement_recovered"] = (
+            bytes(slow.client_id)
+            not in self.a.store.placement_demoted_peers())
+
     # --- gates -------------------------------------------------------------
 
     def _assertions(self, error, counters) -> List[sc.Assertion]:
@@ -433,7 +492,7 @@ class ScenarioHarness:
         out = [A("phases_completed", error is None,
                  "" if error is None else f"{error[0]}: {error[1]}")]
         want_backups = sum(1 for p in spec.phases
-                           if p.kind in ("backup", "churn", "race"))
+                           if p.kind in ("backup", "churn", "race", "wan"))
         out.append(A("backups_completed",
                      facts["backups"] >= want_backups,
                      f"{facts['backups']}/{want_backups}"))
@@ -479,6 +538,29 @@ class ScenarioHarness:
                          dispatches > 0 and samples > 0,
                          f"dispatches={dispatches:g}"
                          f" peer_samples={samples:g}"))
+        if any(p.kind == "wan" for p in spec.phases):
+            resumes = sum(
+                v for k, v in counters.items()
+                if k.startswith("bkw_transfer_resumes_total"))
+            out.append(A("resume_exercised", resumes >= 1,
+                         f"resumes={resumes:g}"))
+            resent = sum(
+                v for k, v in counters.items()
+                if k.startswith("bkw_transfer_bytes_resent_total"))
+            sent = sum(
+                v for k, v in counters.items()
+                if k.startswith("bkw_transfer_bytes_total"))
+            # resume must pay back: re-sent bytes a small fraction of
+            # the payload bytes moved, not a restart-from-zero doubling
+            out.append(A("resent_under_budget",
+                         resent <= 0.25 * max(sent, 1.0),
+                         f"resent={resent:g} of {sent:g} sent"))
+            out.append(A("placement_capacity_aware",
+                         facts.get("wan_placement_ok") is True,
+                         "shards landed on measured-fast holders only"))
+            out.append(A("placement_demotion_recovered",
+                         facts.get("wan_placement_recovered") is True,
+                         "probation expiry re-admitted the slow holder"))
         return out
 
 
@@ -530,6 +612,9 @@ def builtin_scenarios() -> Dict[str, ScenarioSpec]:
                     P("repair"),
                     P("race", grow=True),
                     P("restore"))),
+        "wan": ScenarioSpec(
+            name="wan", seed=71, corpus_files=4, chunk_bytes=4096,
+            phases=(P("wan"), P("restore"))),
         "full": ScenarioSpec(
             name="full", seed=61, spares=2, corpus_files=10,
             corpus_file_bytes=48 * 1024, min_shards_rebuilt=1,

@@ -55,6 +55,15 @@ COUNTER_FAMILIES = (
     "bkw_device_dispatch_total",
     "bkw_pipeline_stage_bytes_total",
     "bkw_peer_transfer_samples_total",
+    # resumable WAN transfer plane (PR 8): chunked frames, byte-range
+    # resume accounting, stall aborts, and capacity-aware placement
+    "bkw_p2p_bytes_sent_total",
+    "bkw_p2p_sequence_breaks_total",
+    "bkw_transfer_parts_total",
+    "bkw_transfer_resumes_total",
+    "bkw_transfer_stalls_total",
+    "bkw_transfer_bytes_resent_total",
+    "bkw_placement_demotions_total",
 )
 
 #: Histogram families quantiled in the card.

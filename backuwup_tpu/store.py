@@ -22,6 +22,7 @@ test seam the reference uses to run N clients on one machine
 from __future__ import annotations
 
 import json
+import math
 import os
 import sqlite3
 import threading
@@ -29,6 +30,8 @@ import time
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Optional
+
+from . import defaults
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS config (
@@ -75,7 +78,9 @@ CREATE TABLE IF NOT EXISTS peer_stats (
     latency_s REAL NOT NULL DEFAULT 0,
     success REAL NOT NULL DEFAULT 1,
     samples INTEGER NOT NULL DEFAULT 0,
-    updated REAL NOT NULL DEFAULT 0
+    updated REAL NOT NULL DEFAULT 0,
+    placement_demoted INTEGER NOT NULL DEFAULT 0,
+    placement_demoted_at REAL NOT NULL DEFAULT 0
 );
 """
 
@@ -123,6 +128,12 @@ class PeerStatsRow:
     success: float = 1.0
     samples: int = 0
     updated: float = 0.0
+    #: placement demotion — distinct from audit demotion (audit_ledger):
+    #: the peer is measured too slow/flaky to receive NEW placements, but
+    #: its held data still counts and it recovers after probation or a
+    #: run of successes.
+    placement_demoted: bool = False
+    placement_demoted_at: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -164,6 +175,14 @@ class Store:
                 " shard_index INTEGER NOT NULL DEFAULT -1")
         except sqlite3.OperationalError:
             pass  # already present
+        # WAN-era placement-demotion columns on pre-existing databases
+        for clause in ("placement_demoted INTEGER NOT NULL DEFAULT 0",
+                       "placement_demoted_at REAL NOT NULL DEFAULT 0"):
+            try:
+                self._db.execute(
+                    f"ALTER TABLE peer_stats ADD COLUMN {clause}")
+            except sqlite3.OperationalError:
+                pass  # already present
         self._db.commit()
 
     def close(self) -> None:
@@ -306,18 +325,37 @@ class Store:
         return [PeerInfo(bytes(r[0]), *r[1:]) for r in rows]
 
     def find_peers_with_storage(self, exclude=()) -> list:
-        """Peers ordered by free (negotiated - transmitted) storage, most
-        first (peers.rs:176-193).  Peers the audit ledger demoted are
-        excluded entirely: a peer proven to drop data must not receive more.
-        ``exclude`` adds caller-side exclusions (the repair round must not
-        re-place data on the very peers it is repairing away from).
+        """Peers ordered by measured capacity (throughput × success from
+        the persisted EWMA estimators), free storage as tiebreak — bytes
+        go where they are most likely to land fast (peers.rs:176-193
+        ordered by free space alone).  Two exclusion sets apply: peers the
+        audit ledger demoted (proven to drop data — never again) and
+        placement-demoted peers (measured too slow/flaky — sit out until
+        probation expires or successes recover them).  ``exclude`` adds
+        caller-side exclusions (the repair round must not re-place data on
+        the very peers it is repairing away from).
         """
-        avoid = self.demoted_peers() | {bytes(p) for p in exclude}
+        avoid = (self.demoted_peers() | self.placement_demoted_peers()
+                 | {bytes(p) for p in exclude})
         peers = [p for p in self.list_peers()
                  if p.free_storage > 0 and p.pubkey not in avoid]
-        # deterministic tie-break: free space desc, then pubkey — shard
-        # placement must be reproducible under the seeded fault plane
-        peers.sort(key=lambda p: (-p.free_storage, p.pubkey))
+        stats = {s.peer: s for s in self.all_peer_stats()}
+
+        def bucket(p: "PeerInfo") -> int:
+            # log2 buckets keep the ordering deterministic under EWMA
+            # jitter: a 2x capacity gap reorders, a 3% one does not.
+            # Unmeasured peers score a neutral floor so newcomers are
+            # neither starved nor preferred over proven-fast peers.
+            st = stats.get(p.pubkey)
+            if st is None or st.samples < defaults.PLACEMENT_MIN_SAMPLES:
+                score = float(defaults.PLACEMENT_NEUTRAL_SCORE_BPS)
+            else:
+                score = st.throughput_bps * max(st.success, 0.0)
+            return int(math.log2(max(score, 1.0)))
+
+        # deterministic: capacity bucket desc, free space desc, pubkey —
+        # shard placement must be reproducible under the seeded fault plane
+        peers.sort(key=lambda p: (-bucket(p), -p.free_storage, p.pubkey))
         return peers
 
     # --- packfile placements (verifier's who-holds-what map) ----------------
@@ -481,11 +519,12 @@ class Store:
         with self._lock:
             row = self._db.execute(
                 "SELECT peer, throughput_bps, latency_s, success, samples,"
-                " updated FROM peer_stats WHERE peer = ?",
+                " updated, placement_demoted, placement_demoted_at"
+                " FROM peer_stats WHERE peer = ?",
                 (bytes(peer),)).fetchone()
         if row is None:
             return None
-        return PeerStatsRow(bytes(row[0]), *row[1:])
+        return PeerStatsRow(bytes(row[0]), *row[1:6], bool(row[6]), row[7])
 
     def put_peer_stats(self, row: "PeerStatsRow") -> None:
         with self._lock:
@@ -507,8 +546,46 @@ class Store:
         with self._lock:
             rows = self._db.execute(
                 "SELECT peer, throughput_bps, latency_s, success, samples,"
-                " updated FROM peer_stats").fetchall()
-        return [PeerStatsRow(bytes(r[0]), *r[1:]) for r in rows]
+                " updated, placement_demoted, placement_demoted_at"
+                " FROM peer_stats").fetchall()
+        return [PeerStatsRow(bytes(r[0]), *r[1:6], bool(r[6]), r[7])
+                for r in rows]
+
+    def set_placement_demoted(self, peer: bytes, demoted: bool,
+                              now: Optional[float] = None) -> None:
+        """Flip a peer's placement-demotion flag (distinct from the audit
+        ledger's demotion: this one is about measured capacity, not proven
+        data loss, and is recoverable)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            cur = self._db.execute(
+                "UPDATE peer_stats SET placement_demoted = ?,"
+                " placement_demoted_at = ? WHERE peer = ?",
+                (int(demoted), now if demoted else 0.0, bytes(peer)))
+            if cur.rowcount == 0:
+                self._db.execute(
+                    "INSERT INTO peer_stats (peer, placement_demoted,"
+                    " placement_demoted_at, updated) VALUES (?, ?, ?, ?)",
+                    (bytes(peer), int(demoted),
+                     now if demoted else 0.0, now))
+            self._db.commit()
+
+    def placement_demoted_peers(self, now: Optional[float] = None) -> set:
+        """Peers currently placement-demoted.  Probation is lazy: a row
+        demoted longer than ``PLACEMENT_PROBATION_S`` ago is cleared here
+        — the peer gets another chance to prove itself."""
+        now = time.time() if now is None else now
+        cutoff = now - defaults.PLACEMENT_PROBATION_S
+        with self._lock:
+            self._db.execute(
+                "UPDATE peer_stats SET placement_demoted = 0,"
+                " placement_demoted_at = 0 WHERE placement_demoted = 1"
+                " AND placement_demoted_at <= ?", (cutoff,))
+            self._db.commit()
+            rows = self._db.execute(
+                "SELECT peer FROM peer_stats"
+                " WHERE placement_demoted = 1").fetchall()
+        return {bytes(r[0]) for r in rows}
 
     # --- audit challenge cursor (single-use table entries) ------------------
 

@@ -17,6 +17,12 @@ Transport/Node seam in :mod:`backuwup_tpu.net.p2p` start injecting
   accepts no dial, and every in-flight transport to it fails on the next
   send.  :meth:`FaultPlane.kill_after` arms death after N successful
   sends — "the peer vanished mid-backup".
+* **mid-transfer cuts** — :meth:`FaultPlane.arm_cut` arms exact byte
+  offsets per peer; the chunked sender dies on the FILE_PART covering an
+  armed offset (``cut_part`` is the rate-based version).  The resume
+  protocol (docs/transfer.md) must continue from the persisted offset.
+* **flaky reconnect** — ``reconnect_fail`` makes a fraction of p2p dials
+  fail outright, the residential-NAT reconnect lottery.
 
 Two properties the acceptance bar demands, by construction:
 
@@ -75,14 +81,18 @@ class FaultPlane:
 
     def __init__(self, seed: int = 0, *, drop_send: float = 0.0,
                  corrupt_frame: float = 0.0, withhold_ack: float = 0.0,
-                 latency: float = 0.0, latency_s: float = 0.05):
+                 latency: float = 0.0, latency_s: float = 0.05,
+                 cut_part: float = 0.0, reconnect_fail: float = 0.0):
         self.seed = int(seed)
         self.drop_send = float(drop_send)
         self.corrupt_frame = float(corrupt_frame)
         self.withhold_ack = float(withhold_ack)
         self.latency = float(latency)
         self.latency_s = float(latency_s)
+        self.cut_part = float(cut_part)
+        self.reconnect_fail = float(reconnect_fail)
         self.dead: Set[bytes] = set()
+        self._cuts: Dict[bytes, Set[int]] = {}
         self._kill_after: Dict[bytes, int] = {}
         self._rngs: Dict[str, random.Random] = {}
         self._queries: Dict[str, int] = {}
@@ -169,6 +179,41 @@ class FaultPlane:
             return ACT_CORRUPT
         return None
 
+    def arm_cut(self, peer_id: bytes, *offsets: int) -> None:
+        """Arm exact-offset mid-transfer cuts toward ``peer_id``: the
+        connection dies on the FILE_PART whose byte range covers an armed
+        offset (one-shot per offset) — "the WAN link dropped at byte N of
+        the shard", the deterministic-resume test API."""
+        self._cuts.setdefault(bytes(peer_id), set()).update(
+            int(o) for o in offsets)
+
+    def on_send_part(self, peer_id: bytes, offset: int,
+                     size: int) -> Optional[str]:
+        """Called before shipping a FILE_PART covering
+        ``[offset, offset + size)``.  Exact-offset cuts fire first (armed,
+        one-shot), then the seeded ``cut_part`` rate."""
+        hexid = bytes(peer_id).hex()
+        armed = self._cuts.get(bytes(peer_id))
+        if armed:
+            hit = [c for c in armed if offset <= c < offset + size]
+            if hit:
+                for c in hit:
+                    armed.discard(c)
+                site = f"send.cut:{hexid}"
+                self.fired[site] = self.fired.get(site, 0) + 1
+                _record_injection(site)
+                return ACT_DROP
+        if self.cut_part > 0.0 and self.decide(f"send.cut:{hexid}",
+                                               self.cut_part):
+            return ACT_DROP
+        return None
+
+    def flaky_reconnect(self, peer_id: bytes) -> bool:
+        """Called by P2PNode.connect before dialing: True = this dial is
+        refused, as a flaky residential peer would."""
+        return self.decide(f"dial.flaky:{bytes(peer_id).hex()}",
+                           self.reconnect_fail)
+
     def corrupt(self, raw: bytes, peer_id: bytes) -> bytes:
         """Flip one deterministically chosen byte of the signed frame."""
         rng = self._rng(f"corrupt.byte:{bytes(peer_id).hex()}")
@@ -221,7 +266,7 @@ def from_env(spec: Optional[str] = None) -> Optional[FaultPlane]:
         elif key == "seed":
             kw["seed"] = int(value)
         elif key in ("drop_send", "corrupt_frame", "withhold_ack",
-                     "latency", "latency_s"):
+                     "latency", "latency_s", "cut_part", "reconnect_fail"):
             kw[key] = float(value)
         else:
             raise ValueError(f"unknown BKW_FAULTS key {key!r}")

@@ -497,6 +497,13 @@ class P2PBodyKind(IntEnum):
     ACK = 2
     CHALLENGE = 3  # storage-attestation challenge batch
     PROOF = 4  # storage-attestation proof batch
+    # resumable chunked transfer (docs/transfer.md resume protocol).
+    # FILE frames stay on the wire unchanged, so peers that only speak
+    # the whole-file path keep interoperating; the three kinds below are
+    # additive.
+    FILE_PART = 5  # one byte range of a file, acked like FILE
+    RESUME_QUERY = 6  # sender asks: how much of file_id do you hold?
+    RESUME_OFFER = 7  # receiver's answer, echoing the query's sequence
 
 
 class ProofStatus(IntEnum):
@@ -570,12 +577,16 @@ class P2PBody:
     kind: P2PBodyKind
     header: P2PHeader
     request_type: Optional[RequestType] = None  # REQUEST
-    file_info: Optional[FileInfoKind] = None  # FILE
+    file_info: Optional[FileInfoKind] = None  # FILE / FILE_PART / RESUME_QUERY
     file_id: bytes = b""  # FILE: packfile id or index number (LE bytes)
-    data: bytes = b""  # FILE payload
+    data: bytes = b""  # FILE / FILE_PART payload
     acked_sequence: int = 0  # ACK
     challenges: tuple = ()  # CHALLENGE: StorageChallenge...
     proofs: tuple = ()  # PROOF: StorageProof...
+    offset: int = 0  # FILE_PART: byte offset / RESUME_OFFER: verified bytes held
+    total_size: int = 0  # FILE_PART: whole-file length
+    file_digest: bytes = b""  # FILE_PART / RESUME_OFFER: whole-file blake3
+    prefix_digest: bytes = b""  # RESUME_OFFER: blake3 of the held prefix
 
     def encode_bytes(self) -> bytes:
         w = Writer()
@@ -597,6 +608,22 @@ class P2PBody:
             w.u64(len(self.proofs))
             for p in self.proofs:
                 p.encode(w)
+        elif self.kind == P2PBodyKind.FILE_PART:
+            w.u32(int(self.file_info))
+            w.blob(self.file_id)
+            w.u64(self.offset)
+            w.u64(self.total_size)
+            w.fixed(_check("file digest", self.file_digest, BLOB_HASH_LEN))
+            w.blob(self.data)
+        elif self.kind == P2PBodyKind.RESUME_QUERY:
+            w.u32(int(self.file_info))
+            w.blob(self.file_id)
+        elif self.kind == P2PBodyKind.RESUME_OFFER:
+            w.blob(self.file_id)
+            w.u64(self.offset)
+            # both digests are empty blobs when nothing is held
+            w.blob(self.file_digest)
+            w.blob(self.prefix_digest)
         return w.take()
 
     @classmethod
@@ -619,6 +646,21 @@ class P2PBody:
         elif kind == P2PBodyKind.PROOF:
             kw["proofs"] = tuple(
                 StorageProof.decode(r) for _ in range(r.u64()))
+        elif kind == P2PBodyKind.FILE_PART:
+            kw["file_info"] = FileInfoKind(r.u32())
+            kw["file_id"] = r.blob()
+            kw["offset"] = r.u64()
+            kw["total_size"] = r.u64()
+            kw["file_digest"] = r.fixed(BLOB_HASH_LEN)
+            kw["data"] = r.blob()
+        elif kind == P2PBodyKind.RESUME_QUERY:
+            kw["file_info"] = FileInfoKind(r.u32())
+            kw["file_id"] = r.blob()
+        elif kind == P2PBodyKind.RESUME_OFFER:
+            kw["file_id"] = r.blob()
+            kw["offset"] = r.u64()
+            kw["file_digest"] = r.blob()
+            kw["prefix_digest"] = r.blob()
         r.expect_end()
         return cls(kind=kind, header=header, **kw)
 

@@ -20,10 +20,15 @@ identical dedup output is meaningless):
   #7  erasure coding      — RS shard encode/decode throughput
   #8  transfer plane      — serial-vs-concurrent end-to-end backup over
       loopback p2p with N latency-injected peers (ratio, not sustained)
+  #9  chaos scenario      — the composed scorecard gate embedded in the
+      bench record (durability regression tripwire)
+  #10 wan resume          — resume-enabled vs restart-from-zero
+      bytes-on-wire across two injected mid-transfer cuts (ratio)
 
 Environment knobs: BENCH_C2_FILES, BENCH_C3_MIB, BENCH_C4_GIB,
 BENCH_C5_HASHES, BENCH_C6_MIB, BENCH_C7_SHARD_KIB, BENCH_C7_STRIPES,
-BENCH_C8_MIB, BENCH_C8_PEERS, BENCH_C8_LATENCY_S.
+BENCH_C8_MIB, BENCH_C8_PEERS, BENCH_C8_LATENCY_S, BENCH_C10_KIB,
+BENCH_C10_CHUNK_KIB.
 """
 
 from __future__ import annotations
@@ -719,6 +724,130 @@ def config8_transfer(log: Callable) -> Dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def config10_wan(log: Callable) -> Dict:
+    """Resume-enabled vs restart-from-zero over a cut WAN link — #10.
+
+    One source and one holder over loopback p2p, a 512 KiB payload
+    chunked into 16 KiB FILE_PART frames, and the SAME two armed
+    exact-offset cuts (at 256 KiB and 384 KiB) severing the connection
+    mid-transfer in both legs:
+
+      resume  — TRANSFER_RESUME_ENABLED semantics: each reconnect runs
+                the RESUME_QUERY/RESUME_OFFER handshake and continues
+                from the receiver's verified partial
+      restart — resume negotiation disabled, so every reconnect starts
+                the file over from byte zero (the pre-resume shape)
+
+    Both legs report sender-side bytes-on-wire (the
+    bkw_p2p_bytes_sent_total delta — every outbound frame crosses the
+    one transport chokepoint) and wall clock in one record; the ratio
+    is the acceptance number (expected ~0.44, gate <= 0.6).
+    """
+    import asyncio
+    import contextlib
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from backuwup_tpu import defaults, wire
+    from backuwup_tpu.app import ClientApp
+    from backuwup_tpu.net.p2p import P2PError
+    from backuwup_tpu.net.server import CoordinationServer
+    from backuwup_tpu.obs import metrics as obs_metrics
+    from backuwup_tpu.utils import faults
+
+    payload_kib = int(os.environ.get("BENCH_C10_KIB", "512"))
+    chunk_kib = int(os.environ.get("BENCH_C10_CHUNK_KIB", "16"))
+    cuts = (payload_kib << 10) // 2, 3 * (payload_kib << 10) // 4
+
+    saved = defaults.TRANSFER_CHUNK_BYTES
+    tmp = Path(tempfile.mkdtemp(prefix="bkw_bench_c10_"))
+    rng = np.random.default_rng(101)
+    data = rng.bytes(payload_kib << 10)
+
+    def wire_bytes() -> float:
+        fam = obs_metrics.registry().snapshot().get(
+            "bkw_p2p_bytes_sent_total") or {}
+        return sum(s["value"] for s in fam.get("series", []))
+
+    async def one_leg(a: ClientApp, holder_id: bytes, plane,
+                      file_id: bytes, resume: bool) -> Dict:
+        plane.arm_cut(holder_id, *cuts)
+        before, t0 = wire_bytes(), time.time()
+        t = await a.node.connect(holder_id, wire.RequestType.TRANSPORT,
+                                 timeout=10.0)
+        try:
+            for _ in range(len(cuts) + 2):
+                try:
+                    await t.send_file(data, wire.FileInfoKind.PACKFILE,
+                                      file_id, resume=resume)
+                    break
+                except P2PError:
+                    t = await a.node.connect(
+                        holder_id, wire.RequestType.TRANSPORT, timeout=10.0)
+            else:
+                raise RuntimeError("config #10: transfer never completed")
+        finally:
+            with contextlib.suppress(Exception):
+                await t.close()
+        return {"bytes_wire": round(wire_bytes() - before),
+                "wall_s": round(time.time() - t0, 3)}
+
+    async def both() -> Dict:
+        plane = faults.install(faults.FaultPlane(seed=101))
+        server = CoordinationServer(db_path=str(tmp / "server.db"))
+        port = await server.start()
+
+        def make_app(name):
+            app = ClientApp(config_dir=tmp / name / "cfg",
+                            data_dir=tmp / name / "data",
+                            server_addr=f"127.0.0.1:{port}",
+                            tls=False)  # plaintext loopback deployment
+            return app
+
+        a, h = make_app("a"), make_app("h")
+        try:
+            for app in (a, h):
+                await app.start()
+                app._audit_task.cancel()
+            amt = 64 << 20
+            a.store.add_peer_negotiated(h.client_id, amt)
+            h.store.add_peer_negotiated(a.client_id, amt)
+            server.db.save_storage_negotiated(
+                bytes(a.client_id), bytes(h.client_id), amt)
+            legs = {}
+            legs["resume"] = await one_leg(
+                a, h.client_id, plane, bytes(range(32)), resume=True)
+            legs["restart"] = await one_leg(
+                a, h.client_id, plane, bytes(range(32, 64)), resume=False)
+            return legs
+        finally:
+            for app in (a, h):
+                with contextlib.suppress(Exception):
+                    await app.stop()
+            await server.stop()
+            faults.uninstall()
+
+    try:
+        defaults.TRANSFER_CHUNK_BYTES = chunk_kib << 10
+        legs = asyncio.run(both())
+        ratio = legs["resume"]["bytes_wire"] / max(
+            legs["restart"]["bytes_wire"], 1)
+        log(f"config#10 wan resume: {payload_kib} KiB across 2 cuts: "
+            f"resume {legs['resume']['bytes_wire']} B on wire in "
+            f"{legs['resume']['wall_s']}s, restart "
+            f"{legs['restart']['bytes_wire']} B in "
+            f"{legs['restart']['wall_s']}s = {ratio:.2f}x")
+        return {"payload_kib": payload_kib, "chunk_kib": chunk_kib,
+                "cut_offsets": list(cuts), "resume": legs["resume"],
+                "restart": legs["restart"], "ratio": round(ratio, 3),
+                "wall_s": round(legs["resume"]["wall_s"]
+                                + legs["restart"]["wall_s"], 2)}
+    finally:
+        defaults.TRANSFER_CHUNK_BYTES = saved
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def config9_scenario(log: Callable) -> Dict:
     """Composed chaos scenario + scorecard gate — config #9.
 
@@ -767,7 +896,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("6_end_to_end", lambda: config6_end_to_end(log)),
             ("7_erasure", lambda: config7_erasure(log)),
             ("8_transfer", lambda: config8_transfer(log)),
-            ("9_scenario", lambda: config9_scenario(log))):
+            ("9_scenario", lambda: config9_scenario(log)),
+            ("10_wan", lambda: config10_wan(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

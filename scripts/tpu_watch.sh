@@ -63,6 +63,18 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "scenario bench recapture FAILED (see $scn) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated wan-resume recapture: config #10 alone (host-only
+        # loopback p2p, resume-vs-restart bytes-on-wire ratio across two
+        # injected mid-transfer cuts) — the resume payoff number
+        # survives even when the device suite timed out partway
+        wan="$BENCH_OUT_DIR/BENCH_wan_${stamp}.json"
+        if timeout "${BENCH_WAN_TIMEOUT_S:-600}" \
+                env BENCH_ONLY_CONFIG=10_wan BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$wan" 2>>/tmp/tpu_watch.log; then
+            echo "wan bench recaptured to $wan at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "wan bench recapture FAILED (see $wan) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

@@ -378,7 +378,12 @@ def test_audit_e2e_detects_corruption_and_demotes(tmp_path, loop,
         pack_dir = b.store.received_dir(a.client_id) / "pack"
         victim = sorted(pack_dir.iterdir())[0]
         blob = bytearray(victim.read_bytes())
-        blob[len(blob) // 2] ^= 0xFF
+        # flip a byte every quarter-window so EVERY possible sampled
+        # window covers corruption — the verdict must not depend on
+        # which os.urandom table entries this round happens to burn
+        for off in range(0, len(blob),
+                         max(1, defaults.AUDIT_WINDOW_BYTES // 4)):
+            blob[off] ^= 0xFF
         victim.write_bytes(bytes(blob))
         a.store.mark_audit_due(b.client_id)
         results = await asyncio.wait_for(a.engine.run_audit_round(), 60)

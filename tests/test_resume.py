@@ -1,0 +1,561 @@
+"""Resumable WAN transfer plane (docs/transfer.md resume protocol).
+
+Units for the pieces — PartialStore contiguity/verification,
+validate_resume_offer outcomes, adaptive deadlines, the outbound fault
+chokepoint, sequence-break telemetry, capacity-aware placement — plus
+loopback e2e runs proving a chunked transfer survives an injected
+mid-transfer cut by resuming from the receiver's verified partial
+(re-sent bytes a fraction of the payload, never the whole file again).
+"""
+
+import asyncio
+import time
+from dataclasses import dataclass
+
+import pytest
+
+from backuwup_tpu import defaults, wire
+from backuwup_tpu.crypto import KeyManager
+from backuwup_tpu.net.client import ServerClient
+from backuwup_tpu.net.p2p import (
+    P2PError,
+    P2PNode,
+    PartialStore,
+    ReceivedFilesWriter,
+    Receiver,
+    SendProgress,
+    Transport,
+    adaptive_deadline,
+    validate_resume_offer,
+)
+from backuwup_tpu.net.peer_stats import PeerStats
+from backuwup_tpu.net.server import CoordinationServer
+from backuwup_tpu.obs import metrics as obs_metrics
+from backuwup_tpu.ops.blake3_cpu import blake3_many
+from backuwup_tpu.store import PeerStatsRow, Store
+from backuwup_tpu.utils import faults
+
+K = wire.FileInfoKind.PACKFILE
+NONCE = b"\x00" * 16
+
+
+def _digest(data: bytes) -> bytes:
+    return blake3_many([data])[0]
+
+
+def _fam_total(name: str, **labels) -> float:
+    """Sum a counter family's series, optionally filtered by labels."""
+    fam = obs_metrics.registry().snapshot().get(name) or {}
+    total = 0.0
+    for s in fam.get("series", []):
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            total += s["value"]
+    return total
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+# --- adaptive deadlines -----------------------------------------------------
+
+
+def test_adaptive_deadline_scales_with_size_and_caps():
+    base = defaults.ACK_TIMEOUT_S
+    floor = defaults.TRANSFER_MIN_THROUGHPUT_BPS
+    assert adaptive_deadline(0) == pytest.approx(base)
+    assert adaptive_deadline(floor) == pytest.approx(base + 1.0)
+    # a fast measured peer tightens the budget below the min-rate floor's
+    hundred_mib = 100 << 20
+    assert adaptive_deadline(hundred_mib, 100e6) \
+        < adaptive_deadline(hundred_mib)
+    # but never below the ack floor, and never above the cap
+    assert adaptive_deadline(1, 1e12) >= base
+    assert adaptive_deadline(1 << 40) == defaults.TRANSFER_DEADLINE_CAP_S
+
+
+# --- PartialStore -----------------------------------------------------------
+
+
+def test_partial_store_contiguous_roundtrip(tmp_path, rng):
+    ps = PartialStore(tmp_path / "partial")
+    data = rng.randbytes(10_240)
+    dg, fid = _digest(data), b"\x01" * 12
+    assert ps.append(K, fid, 0, len(data), dg, data[:4096]) is None
+    held, digest, prefix = ps.query(fid)
+    assert (held, digest, prefix) == (4096, dg, _digest(data[:4096]))
+    assert ps.append(K, fid, 4096, len(data), dg, data[4096:8192]) is None
+    assert ps.append(K, fid, 8192, len(data), dg, data[8192:]) == data
+    # completion consumes the staging files
+    assert ps.query(fid) == (0, b"", b"")
+
+
+def test_partial_store_rejects_gaps_and_unknown_continuations(tmp_path, rng):
+    ps = PartialStore(tmp_path / "partial")
+    data = rng.randbytes(12_288)
+    dg = _digest(data)
+    with pytest.raises(P2PError, match="unknown partial"):
+        ps.append(K, b"\x02" * 12, 4096, len(data), dg, data[4096:8192])
+    ps.append(K, b"\x03" * 12, 0, len(data), dg, data[:4096])
+    with pytest.raises(P2PError, match="non-contiguous"):
+        ps.append(K, b"\x03" * 12, 8192, len(data), dg, data[8192:])
+
+
+def test_partial_store_metadata_mismatch_discards(tmp_path, rng):
+    ps = PartialStore(tmp_path / "partial")
+    data, fid = rng.randbytes(12_288), b"\x04" * 12
+    ps.append(K, fid, 0, len(data), _digest(data), data[:4096])
+    # a continuation claiming a different file version kills the partial
+    with pytest.raises(P2PError, match="metadata mismatch"):
+        ps.append(K, fid, 4096, len(data), _digest(b"other"),
+                  data[4096:8192])
+    assert ps.query(fid) == (0, b"", b"")
+
+
+def test_partial_store_part_zero_truncates_stale_bytes(tmp_path, rng):
+    """A sender restarting from zero (stale/corrupt offer) implicitly
+    discards whatever the receiver held for that file id."""
+    ps = PartialStore(tmp_path / "partial")
+    fid = b"\x05" * 12
+    old, new = rng.randbytes(10_240), rng.randbytes(8192)
+    ps.append(K, fid, 0, len(old), _digest(old), old[:4096])
+    ps.append(K, fid, 0, len(new), _digest(new), new[:4096])
+    held, digest, prefix = ps.query(fid)
+    assert (held, digest, prefix) == (4096, _digest(new),
+                                      _digest(new[:4096]))
+    assert ps.append(K, fid, 4096, len(new), _digest(new), new[4096:]) == new
+
+
+def test_partial_store_assembled_digest_mismatch_discards(tmp_path, rng):
+    """A corrupted partial is discarded and never handed to the sink."""
+    ps = PartialStore(tmp_path / "partial")
+    data, fid = rng.randbytes(8192), b"\x06" * 12
+    wrong = _digest(b"not-this-file")
+    ps.append(K, fid, 0, len(data), wrong, data[:4096])
+    with pytest.raises(P2PError, match="digest mismatch"):
+        ps.append(K, fid, 4096, len(data), wrong, data[4096:])
+    assert ps.query(fid) == (0, b"", b"")
+
+
+# --- RESUME_OFFER validation ------------------------------------------------
+
+
+def _offer(fid: bytes, offset: int, digest: bytes,
+           prefix: bytes) -> wire.P2PBody:
+    return wire.P2PBody(
+        kind=wire.P2PBodyKind.RESUME_OFFER,
+        header=wire.P2PHeader(sequence_number=1, session_nonce=NONCE),
+        file_id=fid, offset=offset, file_digest=digest,
+        prefix_digest=prefix)
+
+
+def test_resume_offer_verified_prefix_resumes(rng):
+    data, fid = rng.randbytes(10_000), b"\x11" * 12
+    dg = _digest(data)
+    offer = _offer(fid, 4096, dg, _digest(data[:4096]))
+    assert validate_resume_offer(offer, data, dg, fid) == (4096, "resumed")
+
+
+def test_resume_offer_stale_digest_restarts_clean(rng):
+    """The receiver holds a partial of an older file version: restart."""
+    data, fid = rng.randbytes(10_000), b"\x12" * 12
+    old = rng.randbytes(10_000)
+    offer = _offer(fid, 4096, _digest(old), _digest(old[:4096]))
+    assert validate_resume_offer(offer, data, _digest(data), fid) \
+        == (0, "restarted_stale")
+
+
+def test_resume_offer_corrupt_partial_restarts_clean(rng):
+    """Right file, rotten bytes: the prefix digest betrays it."""
+    data, fid = rng.randbytes(10_000), b"\x13" * 12
+    dg = _digest(data)
+    offer = _offer(fid, 4096, dg, _digest(b"bitrot"))
+    assert validate_resume_offer(offer, data, dg, fid) \
+        == (0, "restarted_corrupt")
+
+
+def test_resume_offer_cold_and_bogus_offsets(rng):
+    data, fid = rng.randbytes(1000), b"\x14" * 12
+    dg = _digest(data)
+    assert validate_resume_offer(_offer(fid, 0, b"", b""),
+                                 data, dg, fid) == (0, "cold")
+    # an offset past the file can never be a usable prefix
+    assert validate_resume_offer(_offer(fid, 2000, dg, dg),
+                                 data, dg, fid) == (0, "cold")
+
+
+def test_resume_offer_rejects_wrong_kind_and_file_id(rng):
+    data, fid = rng.randbytes(1000), b"\x15" * 12
+    dg = _digest(data)
+    with pytest.raises(P2PError, match="different file id"):
+        validate_resume_offer(_offer(b"\x16" * 12, 0, b"", b""),
+                              data, dg, fid)
+    wrong_kind = wire.P2PBody(
+        kind=wire.P2PBodyKind.FILE,
+        header=wire.P2PHeader(sequence_number=1, session_nonce=NONCE),
+        file_info=K, file_id=fid, data=b"x")
+    with pytest.raises(P2PError, match="RESUME_OFFER"):
+        validate_resume_offer(wrong_kind, data, dg, fid)
+
+
+# --- transport chokepoint + deadlines (fake socket) -------------------------
+
+
+class _FakeWS:
+    def __init__(self):
+        self.sent = []
+        self.closed = False
+
+    async def send(self, raw):
+        self.sent.append(raw)
+
+    async def close(self):
+        self.closed = True
+
+
+def _fake_transport() -> Transport:
+    keys = KeyManager.from_secret(b"\x05" * 32)
+    return Transport(_FakeWS(), keys, b"\x07" * 32, NONCE)
+
+
+def test_send_body_routes_through_fault_chokepoint(loop):
+    """Satellite-1 regression: control frames (send_body) leave through
+    the SAME chokepoint as FILE frames — an armed drop site severs them
+    too, so no traffic is chaos-immune."""
+    t = _fake_transport()
+    site = f"send.drop:{t.peer_id.hex()}"
+    plane = faults.install(faults.FaultPlane(seed=3))
+    try:
+        plane.arm(site, 0)
+        body = wire.P2PBody(
+            kind=wire.P2PBodyKind.RESUME_QUERY,
+            header=wire.P2PHeader(sequence_number=1, session_nonce=NONCE),
+            file_info=K, file_id=b"\x01" * 12)
+        with pytest.raises(P2PError, match="injected connection drop"):
+            loop.run_until_complete(t.send_body(body))
+        assert plane.fired.get(site) == 1
+        assert t.ws.closed and not t.ws.sent
+    finally:
+        faults.uninstall()
+    # and with no plane installed it ships, counted as bytes on the wire
+    t2 = _fake_transport()
+    before = _fam_total("bkw_p2p_bytes_sent_total")
+    loop.run_until_complete(t2.send_body(body))
+    assert len(t2.ws.sent) == 1
+    assert _fam_total("bkw_p2p_bytes_sent_total") \
+        == before + len(t2.ws.sent[0])
+
+
+def test_legacy_ack_deadline_scales_with_payload(loop, monkeypatch):
+    """Satellite 3: with a tiny flat ACK_TIMEOUT_S, a large FILE frame
+    still gets an ack budget proportional to its size — the same ack
+    arriving late passes for the big payload and stalls the small one."""
+    monkeypatch.setattr(defaults, "ACK_TIMEOUT_S", 0.05)
+    t = _fake_transport()
+    big = b"\x5a" * (128 << 10)  # deadline 0.05 + 128Ki/256Ki = 0.55 s
+
+    async def ack(seq: int, delay: float):
+        while seq not in t._acks:
+            await asyncio.sleep(0.005)
+        await asyncio.sleep(delay)
+        t._acks[seq].set()
+
+    async def run_ok():
+        task = asyncio.create_task(ack(1, 0.2))
+        await t.send_data(big, K, b"\x01" * 12)
+        await task
+
+    loop.run_until_complete(run_ok())
+
+    stalls = _fam_total("bkw_transfer_stalls_total")
+
+    async def run_stall():
+        with pytest.raises(P2PError, match="ack stalled"):
+            await t.send_data(b"tiny", K, b"\x02" * 12)
+
+    loop.run_until_complete(run_stall())
+    assert _fam_total("bkw_transfer_stalls_total") == stalls + 1
+
+
+def test_sequence_break_counts_journals_and_closes(loop):
+    """Satellite 2: replay protection tripping is not a silent hang —
+    the receiver counts it, closes the transport, and errors out."""
+    t = _fake_transport()
+    body = wire.P2PBody(
+        kind=wire.P2PBodyKind.FILE,
+        header=wire.P2PHeader(sequence_number=7, session_nonce=NONCE),
+        file_info=K, file_id=b"\x01" * 12, data=b"zz")
+    sunk = []
+
+    async def sink(kind, fid, data):
+        sunk.append(fid)
+
+    async def run():
+        await t._recv_queue.put(body)
+        before = _fam_total("bkw_p2p_sequence_breaks_total")
+        with pytest.raises(P2PError, match="sequence break"):
+            await Receiver(t, sink).run()
+        assert _fam_total("bkw_p2p_sequence_breaks_total") == before + 1
+
+    loop.run_until_complete(run())
+    assert t.ws.closed and not sunk
+
+
+def test_flaky_reconnect_site_refuses_dial(tmp_path, loop):
+    keys = KeyManager.from_secret(b"\x09" * 32)
+    store = Store(tmp_path / "cfg", data_base=tmp_path / "data")
+
+    class _ServerStub:  # P2PNode only assigns push handlers onto it
+        pass
+
+    node = P2PNode(keys, store, _ServerStub())
+    peer = b"\x0a" * 32
+    site = f"dial.flaky:{peer.hex()}"
+    plane = faults.install(faults.FaultPlane(seed=5))
+    try:
+        plane.arm(site, 0)
+        with pytest.raises(P2PError, match="flaky reconnect"):
+            loop.run_until_complete(node.connect(
+                peer, wire.RequestType.TRANSPORT, timeout=0.5))
+        assert plane.fired.get(site) == 1
+    finally:
+        faults.uninstall()
+        store.close()
+
+
+# --- capacity-aware placement -----------------------------------------------
+
+
+def test_placement_orders_by_measured_capacity(tmp_path):
+    store = Store(tmp_path / "cfg", data_base=tmp_path / "data")
+    fast, slow, fresh = b"\xaa" * 32, b"\xbb" * 32, b"\xcc" * 32
+    store.add_peer_negotiated(fast, 10_000_000)
+    store.add_peer_negotiated(slow, 20_000_000)  # most free space
+    store.add_peer_negotiated(fresh, 5_000_000)
+    now = time.time()
+    store.put_peer_stats(PeerStatsRow(fast, 50e6, 0.01, 1.0, 10, now))
+    store.put_peer_stats(PeerStatsRow(slow, 1e5, 0.5, 0.5, 10, now))
+    order = [p.pubkey for p in store.find_peers_with_storage()]
+    # measured-fast first despite the least free space; the unmeasured
+    # newcomer scores the neutral floor, above the measured-slow peer
+    assert order == [fast, fresh, slow]
+    store.close()
+
+
+def test_placement_demotion_excludes_and_probation_recovers(tmp_path):
+    store = Store(tmp_path / "cfg", data_base=tmp_path / "data")
+    peer = b"\xdd" * 32
+    store.add_peer_negotiated(peer, 1_000_000)
+    store.set_placement_demoted(peer, True)
+    assert peer in store.placement_demoted_peers()
+    assert peer not in [p.pubkey for p in store.find_peers_with_storage()]
+    # distinct from audit demotion: the probation window re-admits it
+    store.set_placement_demoted(
+        peer, True, now=time.time() - defaults.PLACEMENT_PROBATION_S - 1)
+    assert peer not in store.placement_demoted_peers()
+    assert peer in [p.pubkey for p in store.find_peers_with_storage()]
+    store.close()
+
+
+@dataclass
+class _Result:
+    peer_id: bytes
+    size: int
+    ok: bool
+    wait_s: float = 0.0
+    send_s: float = 0.1
+
+
+def test_peer_stats_demote_on_failures_recover_on_successes(tmp_path):
+    store = Store(tmp_path / "cfg", data_base=tmp_path / "data")
+    ps = PeerStats(store, alpha=0.5)
+    peer = b"\xee" * 32
+    demotes = _fam_total("bkw_placement_demotions_total", action="demote")
+    for _ in range(defaults.PLACEMENT_DEMOTE_MIN_SAMPLES + 2):
+        ps.observe(_Result(peer, 1000, False))
+    assert peer in store.placement_demoted_peers()
+    assert _fam_total("bkw_placement_demotions_total",
+                      action="demote") == demotes + 1
+    for _ in range(8):
+        ps.observe(_Result(peer, 1000, True))
+    assert peer not in store.placement_demoted_peers()
+    store.close()
+
+
+# --- loopback e2e: chunked transfer + crash-resume --------------------------
+
+
+async def _make_node(tmp_path, name, port):
+    keys = KeyManager.from_secret(
+        bytes([len(name)]) * 31 + name.encode()[:1])
+    store = Store(tmp_path / name / "cfg")
+    store.set_obfuscation_key(b"\x11\x22\x33\x44")
+    client = ServerClient(keys, store, addr=f"127.0.0.1:{port}")
+    await client.register()
+    await client.login()
+    node = P2PNode(keys, store, client)
+    client.start_ws()
+    await asyncio.wait_for(client.ws_connected.wait(), 5)
+    return keys, store, client, node
+
+
+def _resumable_receiver(store, source, transport) -> Receiver:
+    writer = ReceivedFilesWriter(store, source)
+    return Receiver(transport, writer.sink, part_sink=writer.sink_part,
+                    resume_query=writer.resume_offer)
+
+
+def test_chunked_transfer_roundtrip(tmp_path, loop, monkeypatch, rng):
+    monkeypatch.setenv("DATA_DIR", str(tmp_path / "b" / "data"))
+    monkeypatch.setattr(defaults, "TRANSFER_CHUNK_BYTES", 4096)
+
+    async def run():
+        server = CoordinationServer()
+        port = await server.start()
+        ka, sa, ca, na = await _make_node(tmp_path, "a", port)
+        kb, sb, cb, nb = await _make_node(tmp_path, "b", port)
+        sa.add_peer_negotiated(kb.client_id, 10_000_000)
+        sb.add_peer_negotiated(ka.client_id, 10_000_000)
+        done = asyncio.Event()
+
+        async def on_transport(source, transport):
+            await _resumable_receiver(sb, source, transport).run()
+            done.set()
+
+        nb.on_transport_request = on_transport
+        data, pid = rng.randbytes(20_000), b"\x31" * 12
+        parts = _fam_total("bkw_transfer_parts_total")
+        t = await na.connect(kb.client_id, wire.RequestType.TRANSPORT)
+        prog = SendProgress()
+        await t.send_file(data, K, pid, progress=prog)
+        await t.close()
+        await asyncio.wait_for(done.wait(), 10)
+        assert (prog.started, prog.offset) == (0, len(data))
+        assert _fam_total("bkw_transfer_parts_total") - parts == 5
+        writer = ReceivedFilesWriter(sb, ka.client_id)
+        assert {s[1]: s[2] for s in writer.iter_stored()} == {pid: data}
+        # quota counted once for the assembled file, no partial left over
+        assert sb.get_peer(ka.client_id).bytes_received == len(data)
+        assert writer.partials.query(pid) == (0, b"", b"")
+        await ca.close()
+        await cb.close()
+        await server.stop()
+
+    loop.run_until_complete(asyncio.wait_for(run(), 60))
+
+
+def test_crash_cut_resumes_from_verified_offset(tmp_path, loop,
+                                                monkeypatch, rng):
+    """Satellite 4 e2e: an armed exact-offset cut kills the connection
+    mid-transfer; the reconnected sender resumes from the receiver's
+    verified partial — re-sent bytes ≪ the file, assembled bytes exact."""
+    monkeypatch.setenv("DATA_DIR", str(tmp_path / "b" / "data"))
+    monkeypatch.setattr(defaults, "TRANSFER_CHUNK_BYTES", 4096)
+    plane = faults.install(faults.FaultPlane(seed=7))
+    try:
+        async def run():
+            server = CoordinationServer()
+            port = await server.start()
+            ka, sa, ca, na = await _make_node(tmp_path, "a", port)
+            kb, sb, cb, nb = await _make_node(tmp_path, "b", port)
+            sa.add_peer_negotiated(kb.client_id, 10_000_000)
+            sb.add_peer_negotiated(ka.client_id, 10_000_000)
+
+            async def on_transport(source, transport):
+                try:
+                    await _resumable_receiver(sb, source, transport).run()
+                except P2PError:
+                    pass  # the severed session may end mid-frame
+
+            nb.on_transport_request = on_transport
+            data, pid = rng.randbytes(20_000), b"\x41" * 12
+            plane.arm_cut(kb.client_id, 6000)
+            t = await na.connect(kb.client_id, wire.RequestType.TRANSPORT)
+            prog = SendProgress()
+            with pytest.raises(P2PError, match="mid-transfer cut"):
+                await t.send_file(data, K, pid, progress=prog)
+            assert prog.offset == 4096  # one part landed before the cut
+            await asyncio.sleep(0.2)
+            writer = ReceivedFilesWriter(sb, ka.client_id)
+            assert writer.partials.query(pid)[0] == 4096  # survived crash
+
+            resumed = _fam_total("bkw_transfer_resumes_total",
+                                 outcome="resumed")
+            t2 = await na.connect(kb.client_id, wire.RequestType.TRANSPORT)
+            prog2 = SendProgress()
+            await t2.send_file(data, K, pid, progress=prog2)
+            await t2.close()
+            # resumed exactly at the verified offset: only the tail moved
+            assert (prog2.started, prog2.offset) == (4096, len(data))
+            assert _fam_total("bkw_transfer_resumes_total",
+                              outcome="resumed") == resumed + 1
+            assert {s[1]: s[2] for s in writer.iter_stored()} == {pid: data}
+            assert sb.get_peer(ka.client_id).bytes_received == len(data)
+            await ca.close()
+            await cb.close()
+            await server.stop()
+
+        loop.run_until_complete(asyncio.wait_for(run(), 60))
+    finally:
+        faults.uninstall()
+
+
+def test_tampered_partial_restarts_clean_end_to_end(tmp_path, loop,
+                                                    monkeypatch, rng):
+    """A receiver partial corrupted between sessions must NOT be resumed:
+    the sender's prefix check restarts from zero and the file still
+    arrives bit-exact."""
+    monkeypatch.setenv("DATA_DIR", str(tmp_path / "b" / "data"))
+    monkeypatch.setattr(defaults, "TRANSFER_CHUNK_BYTES", 4096)
+    plane = faults.install(faults.FaultPlane(seed=9))
+    try:
+        async def run():
+            server = CoordinationServer()
+            port = await server.start()
+            ka, sa, ca, na = await _make_node(tmp_path, "a", port)
+            kb, sb, cb, nb = await _make_node(tmp_path, "b", port)
+            sa.add_peer_negotiated(kb.client_id, 10_000_000)
+            sb.add_peer_negotiated(ka.client_id, 10_000_000)
+
+            async def on_transport(source, transport):
+                try:
+                    await _resumable_receiver(sb, source, transport).run()
+                except P2PError:
+                    pass
+
+            nb.on_transport_request = on_transport
+            data, pid = rng.randbytes(20_000), b"\x51" * 12
+            plane.arm_cut(kb.client_id, 6000)
+            t = await na.connect(kb.client_id, wire.RequestType.TRANSPORT)
+            with pytest.raises(P2PError, match="mid-transfer cut"):
+                await t.send_file(data, K, pid)
+            await asyncio.sleep(0.2)
+
+            # bitrot the staged partial on the receiver's disk
+            bin_p = sb.received_dir(ka.client_id) / "partial" \
+                / f"{pid.hex()}.bin"
+            blob = bytearray(bin_p.read_bytes())
+            blob[100] ^= 0xFF
+            bin_p.write_bytes(bytes(blob))
+
+            corrupt = _fam_total("bkw_transfer_resumes_total",
+                                 outcome="restarted_corrupt")
+            t2 = await na.connect(kb.client_id, wire.RequestType.TRANSPORT)
+            prog = SendProgress()
+            await t2.send_file(data, K, pid, progress=prog)
+            await t2.close()
+            assert (prog.started, prog.offset) == (0, len(data))
+            assert _fam_total("bkw_transfer_resumes_total",
+                              outcome="restarted_corrupt") == corrupt + 1
+            writer = ReceivedFilesWriter(sb, ka.client_id)
+            assert {s[1]: s[2] for s in writer.iter_stored()} == {pid: data}
+            await ca.close()
+            await cb.close()
+            await server.stop()
+
+        loop.run_until_complete(asyncio.wait_for(run(), 60))
+    finally:
+        faults.uninstall()

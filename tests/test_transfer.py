@@ -68,6 +68,12 @@ class FaultedTransport:
                 raise P2PError("injected: connection dropped")
         self.sent.append((kind, bytes(file_id), len(data)))
 
+    async def send_file(self, data, kind, file_id, *, resume=True,
+                        throughput_bps=0.0, progress=None):
+        # sub-chunk payloads ride the legacy frame, like the real
+        # Transport.send_file
+        await self.send_data(data, kind, file_id)
+
     async def close(self):
         pass
 
