@@ -109,6 +109,15 @@ class ClientApp:
         await self.server.login()
         self.server.start_ws()
         await asyncio.wait_for(self.server.ws_connected.wait(), 10)
+        # reconcile disk against the config DB before ANY scheduler runs:
+        # a previous process may have died mid-commit, and the schedulers
+        # must start from a consistent world (docs/crash_consistency.md)
+        recovery = await self.engine.recover()
+        self.messenger.log(
+            f"recovery: reconciled {recovery['reconciled']} item(s),"
+            f" backlog packfiles={recovery['packfiles_pending']}"
+            f" stripes={recovery['stripes_underplaced']}"
+            f" in {recovery['elapsed_s']:.3f}s")
         self._audit_task = asyncio.create_task(
             self.engine.audit_scheduler())
         self._monitor_task = asyncio.create_task(self.monitor.run())

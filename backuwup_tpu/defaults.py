@@ -183,6 +183,14 @@ DURABILITY_SWEEP_INTERVAL_S = 5.0
 # missed round is routine backoff, four is a stuck verifier).
 DURABILITY_AUDIT_MAX_AGE_S = 4 * AUDIT_INTERVAL_S
 
+# --- crash consistency (engine.recover, net/p2p.py PartialStore janitor,
+# docs/crash_consistency.md; no reference equivalent) -------------------------
+# A receiver-side partial transfer untouched for this long is abandoned:
+# the TTL janitor deletes the bin/json pair and frees the quota.  Kept
+# shorter than PEER_DARK_DEADLINE_S — a sender that has been gone for a
+# day will restart the transfer from its own resume handshake anyway.
+PARTIAL_STORE_TTL_S = 24 * 3600.0
+
 # --- server-side TTLs (reference server/src/client_auth_manager.rs:17-20) ---
 AUTH_CHALLENGE_TTL_S = 30.0
 SESSION_TTL_S = 24 * 3600.0

@@ -50,6 +50,7 @@ from .net.client import NoBackups, ServerClient, ServerError
 from .net.p2p import (
     P2PError,
     P2PNode,
+    PartialStore,
     Receiver,
     RestoreFilesWriter,
     SendProgress,
@@ -67,7 +68,7 @@ from .snapshot.blob_index import BlobIndex, ChallengeTable
 from .snapshot.packer import DirPacker
 from .snapshot.packfile import PackfileReader, PackfileWriter
 from .store import EVENT_BACKUP, EVENT_REPAIR, EVENT_RESTORE_REQUEST, Store
-from .utils import retry, tracing
+from .utils import faults, retry, tracing
 
 
 class EngineError(Exception):
@@ -89,6 +90,21 @@ _BUSY_REJECTS = obs_metrics.counter(
     "bkw_engine_busy_rejections_total",
     "Backup/restore/repair attempts rejected while the engine was busy",
     ("op",))
+_RECOVERY_RUNS = obs_metrics.counter(
+    "bkw_recovery_runs_total", "Startup recovery sweeps run")
+_RECOVERY_ITEMS = obs_metrics.counter(
+    "bkw_recovery_items_total",
+    "Items reconciled by the startup recovery sweep", ("category",))
+_RECOVERY_SECONDS = obs_metrics.histogram(
+    "bkw_recovery_seconds", "Startup recovery sweep wall time")
+
+# Crash-matrix seams around the engine's multi-step placement commits
+_CP_PLACE_PRE = faults.register_crash_site("placement.insert.pre")
+_CP_PLACE_POST = faults.register_crash_site("placement.insert.post")
+_CP_STRIPE_PRE = faults.register_crash_site("stripe.finish.pre")
+_CP_STRIPE_POST = faults.register_crash_site("stripe.finish.post")
+_CP_REHOME_PRE = faults.register_crash_site("repair.rehome.pre")
+_CP_REHOME_POST = faults.register_crash_site("repair.rehome.post")
 
 
 def _registry_stage_sums() -> Dict[str, float]:
@@ -202,6 +218,8 @@ class Engine:
         self.peer_stats = PeerStats(store)
         # per-backup dispatch/bytes/padding roll-up (obs/profile.py)
         self.last_pipeline_report = None
+        # most recent startup recovery sweep report (engine.recover)
+        self.last_recovery: Optional[Dict] = None
 
     @staticmethod
     def _default_mesh():
@@ -278,6 +296,182 @@ class Engine:
         paths must never stall the event loop on a read/unlink/scan."""
         return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
 
+    # --- startup recovery sweep (docs/crash_consistency.md) -----------------
+
+    async def recover(self) -> Dict:
+        """Reconcile disk against the config DB after a (possible) crash.
+
+        Called by ``ClientApp.start`` before any scheduler runs, and
+        idempotent: a second call on a consistent store reconciles zero
+        items.  The sweep
+
+        * deletes orphaned ``.tmp`` files a crashed tmp+replace commit
+          left in the pack / index / challenge directories;
+        * AEAD-verifies every leftover local packfile's header (the
+          GCM tag is the recorded digest) — a torn file is dropped and
+          its blobs forgotten so the next backup re-packs them;
+        * re-adopts verified packfiles the blob index cannot name (the
+          crash beat the index flush): their headers are authoritative,
+          so the blobs roll forward into the index instead of being
+          re-packed from source;
+        * retires placement rows whose packfile neither the index nor
+          the local disk can resurrect — unreachable peer bytes must not
+          masquerade as durability;
+        * finishes packfiles whose placements already completed (the
+          crash hit between the last ack and the local unlink);
+        * counts the rest as the drain backlog, and probes for
+          under-placed stripes with the same
+          :meth:`_queue_underplaced_stripes` walk the repair round uses;
+        * clears stale ``repair_staging/`` and restore staging trees;
+        * expires abandoned partial transfers past
+          ``defaults.PARTIAL_STORE_TTL_S``.
+
+        Emits a ``recovery_report`` journal event and ``bkw_recovery_*``
+        metrics, then (when ``auto_repair`` is on and there is a backlog)
+        schedules the normal background repair round to drain it.
+        """
+        if self._exclusive.locked():
+            _BUSY_REJECTS.inc(op="recover")
+            raise EngineError("a backup or restore is already running")
+        async with self._exclusive:
+            with obs_trace.span("engine.recover"):
+                report = await self._blocking(self._recover_sync)
+        if self.auto_repair and (report["packfiles_pending"]
+                                 or report["stripes_underplaced"]):
+            if self._repair_task is None or self._repair_task.done():
+                self._repair_task = asyncio.create_task(self._auto_repair())
+        return report
+
+    def _recover_sync(self) -> Dict:
+        t0 = time.monotonic()
+        rep: Dict[str, int] = {
+            "tmp_cleaned": 0,
+            "packfiles_corrupt": 0,
+            "packfiles_adopted": 0,
+            "packfiles_completed": 0,
+            "packfiles_pending": 0,
+            "placements_retired": 0,
+            "stripes_underplaced": 0,
+            "staging_cleared": 0,
+            "partials_expired": 0,
+        }
+
+        # orphaned .tmp files from crashed tmp+replace commits
+        pack_base = self._pack_dir()
+        tmp_dirs = [self._index_dir(), self.store.challenge_dir()]
+        if pack_base.is_dir():
+            tmp_dirs.extend(d for d in pack_base.iterdir() if d.is_dir())
+        for d in tmp_dirs:
+            if not d.is_dir():
+                continue
+            for f in d.glob("*.tmp"):
+                try:
+                    f.unlink()
+                    rep["tmp_cleaned"] += 1
+                except OSError:
+                    pass
+
+        # leftover local packfiles: verify, adopt, finish, or keep for the
+        # drain
+        reader = PackfileReader(self.keys, pack_base)
+        geom = self._stripe_geometry()
+        for pid, path, _size in self._unsent_packfiles():
+            try:
+                entries = reader.read_header(pid)
+            except Exception:
+                # torn seal: drop the file and forget its blobs so the
+                # next backup re-packs them from source (the repair
+                # path's forget-then-repack contract)
+                try:
+                    path.unlink()
+                except OSError:
+                    pass
+                self.index.forget_packfiles([pid])
+                rep["packfiles_corrupt"] += 1
+                continue
+            if bytes(pid) not in self.index.packfile_ids():
+                # the crash beat the index flush: the sealed file is the
+                # authoritative record (its header just AEAD-verified),
+                # so roll FORWARD — re-adopt its blobs into the index
+                # instead of re-packing them from source
+                self.index.finalize_packfile(pid, [e.hash for e in entries])
+                rep["packfiles_adopted"] += 1
+            holders = set()
+            whole_placed = False
+            for _peer, idx in self.store.shards_for_packfile(pid):
+                if idx < 0:
+                    whole_placed = True
+                else:
+                    holders.add(int(idx))
+            full_stripe = False
+            if geom is not None and holders:
+                expected = max(geom[0] + geom[1], max(holders) + 1)
+                full_stripe = holders >= set(range(expected))
+            if whole_placed or full_stripe:
+                # every byte is acked on peers; only the local unlink
+                # was lost to the crash
+                try:
+                    path.unlink()
+                except OSError:
+                    pass
+                rep["packfiles_completed"] += 1
+            else:
+                rep["packfiles_pending"] += 1
+
+        if rep["packfiles_adopted"]:
+            self.index.flush()  # adoption must survive the next crash
+
+        # placement rows for packfiles the index cannot name and no local
+        # file can resurrect: unreachable forever (the mapping died with
+        # the crashed process), so retire the rows — leaked peer bytes
+        # stop masquerading as durability
+        unsent_pids = {bytes(pid)
+                       for pid, _p, _s in self._unsent_packfiles()}
+        live_pids = self.index.packfile_ids()
+        stale = sorted({(pid, peer) for pid, peer, _s, _i, _t
+                        in self.store.all_placements()
+                        if pid not in live_pids and pid not in unsent_pids})
+        for pid, peer in stale:
+            rep["placements_retired"] += \
+                self.store.retire_placement(pid, peer)
+
+        # under-placed stripes: the scar the repair round would revisit
+        stripe_lost: Dict = {}
+        self._queue_underplaced_stripes(stripe_lost, {}, set(), unsent_pids)
+        rep["stripes_underplaced"] = len(stripe_lost)
+
+        # stale staging trees: a crashed repair or restore re-pulls from
+        # scratch, so half-staged bytes are only a disk leak
+        for staging in (self.store.data_base / "repair_staging",
+                        self.store.restore_dir()):
+            if staging.is_dir() and any(staging.iterdir()):
+                shutil.rmtree(staging, ignore_errors=True)
+                rep["staging_cleared"] += 1
+
+        # abandoned inbound partials (the receiver-side TTL janitor)
+        recv = self.store.data_base / "received_packfiles"
+        if recv.is_dir():
+            for peer_dir in recv.iterdir():
+                part = peer_dir / "partial"
+                if part.is_dir():
+                    rep["partials_expired"] += PartialStore(part).expire()
+
+        # "reconciled" counts state this sweep actually changed; pending
+        # backlog is observed, not reconciled (the drain owns it)
+        backlog = ("packfiles_pending", "stripes_underplaced")
+        reconciled = sum(v for k, v in rep.items() if k not in backlog)
+        for category, n in rep.items():
+            if n and category not in backlog:
+                _RECOVERY_ITEMS.inc(n, category=category)
+        _RECOVERY_RUNS.inc()
+        dt = time.monotonic() - t0
+        _RECOVERY_SECONDS.observe(dt)
+        rep["reconciled"] = reconciled
+        rep["elapsed_s"] = round(dt, 6)
+        obs_journal.emit("recovery_report", **rep)
+        self.last_recovery = rep
+        return rep
+
     # --- backup ------------------------------------------------------------
 
     async def run_backup(self, root: Optional[Path] = None) -> bytes:
@@ -336,12 +530,15 @@ class Engine:
         send_task = asyncio.create_task(self._send_loop(orch, estimate))
         try:
             await pack_fut
-        except Exception:
+            orch.packing_completed = True
+            self.index.flush()
+        except BaseException:
+            # BaseException on purpose: an injected CrashInjected (and a
+            # cancel of this coroutine) must still tear down the send
+            # loop instead of leaving it spinning against a dead backup
             orch.failed = True
             send_task.cancel()
             raise
-        orch.packing_completed = True
-        self.index.flush()
         try:
             await send_task
         except asyncio.CancelledError:
@@ -586,10 +783,16 @@ class Engine:
             data = await self._blocking(path.read_bytes)
             await self._send_resumable(orch, transport, peer_id, data,
                                        wire.FileInfoKind.PACKFILE, pid)
-            # delete only after ack (send.rs:277-289)
-            await self._blocking(path.unlink)
             self.store.add_peer_transmitted(peer_id, size)
+            faults.crashpoint(_CP_PLACE_PRE)
             self.store.record_placement(pid, peer_id, size)
+            faults.crashpoint(_CP_PLACE_POST)
+            # delete only after ack (send.rs:277-289) AND after the
+            # placement row commits: a crash between the two leaves the
+            # local copy, which recover() finishes against the recorded
+            # placement — the reverse order would strand acked bytes the
+            # DB knows nothing about
+            await self._blocking(path.unlink)
             orch.bytes_sent += size
             orch.adjust_buffer(-size)
             self._progress(bytes_transmitted=orch.bytes_sent)
@@ -710,18 +913,22 @@ class Engine:
                                        wire.FileInfoKind.SHARD,
                                        rs_stripe.shard_id(pid, index))
             self.store.add_peer_transmitted(peer_id, len(container))
+            faults.crashpoint(_CP_PLACE_PRE)
             self.store.record_placement(pid, peer_id, len(container),
                                         shard_index=index)
+            faults.crashpoint(_CP_PLACE_POST)
         return job
 
     async def _finish_stripe(self, orch: Orchestrator, pid: bytes,
                              path: Path, size: int) -> None:
         """Local-delete + accounting once every shard of ``pid`` is acked
         (the striped analogue of the post-ack unlink in the legacy path)."""
+        faults.crashpoint(_CP_STRIPE_PRE)
         try:
             await self._blocking(path.unlink)
         except OSError:
             pass
+        faults.crashpoint(_CP_STRIPE_POST)
         orch.bytes_sent += size
         orch.adjust_buffer(-size)
         self._log(f"packfile {bytes(pid).hex()[:8]} placed as "
@@ -1313,8 +1520,13 @@ class Engine:
                                        wire.FileInfoKind.SHARD,
                                        rs_stripe.shard_id(pidb, idx))
             self.store.add_peer_transmitted(peer_id, len(container))
+            faults.crashpoint(_CP_REHOME_PRE)
             self.store.record_placement(pidb, peer_id, len(container),
                                         shard_index=idx)
+            # record-then-retire: a crash between the two leaves BOTH rows
+            # (over-placed, cleaned by the next repair round's retirement),
+            # never neither (data on a dead peer with no replacement row)
+            faults.crashpoint(_CP_REHOME_POST)
             self.store.retire_placement(pidb, dead_peer)
         return job
 
@@ -1355,12 +1567,15 @@ class Engine:
         send_task = asyncio.create_task(self._send_loop(orch, estimate))
         try:
             await pack_fut
-        except Exception:
+            orch.packing_completed = True
+            self.index.flush()
+        except BaseException:
+            # BaseException on purpose: an injected CrashInjected (and a
+            # cancel of this coroutine) must still tear down the send
+            # loop instead of leaving it spinning against a dead backup
             orch.failed = True
             send_task.cancel()
             raise
-        orch.packing_completed = True
-        self.index.flush()
         try:
             await send_task
         except asyncio.CancelledError:

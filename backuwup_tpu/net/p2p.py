@@ -42,7 +42,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..ops.blake3_cpu import blake3_many
 from ..store import Store
-from ..utils import faults, retry
+from ..utils import durable, faults, retry
 
 _P2P_BYTES = obs_metrics.counter(
     "bkw_p2p_bytes_sent_total",
@@ -59,6 +59,13 @@ _RESUMES = obs_metrics.counter(
 _STALLS = obs_metrics.counter(
     "bkw_transfer_stalls_total",
     "Adaptive-deadline expiries (transfer aborted toward resume)")
+_PARTIALS_EXPIRED = obs_metrics.counter(
+    "bkw_partials_expired_total",
+    "Abandoned partial transfers expired by the receiver-side TTL janitor")
+
+# Crash-matrix seam around the receiver's partial-stage commit
+_CP_PARTIAL_PRE = faults.register_crash_site("partial.sink.pre")
+_CP_PARTIAL_POST = faults.register_crash_site("partial.sink.post")
 
 PURPOSE_TRANSPORT = wire.RequestType.TRANSPORT
 PURPOSE_RESTORE = wire.RequestType.RESTORE_ALL
@@ -546,9 +553,11 @@ class PartialStore:
         offset, total = int(offset), int(total)
         if offset == 0:
             self.base.mkdir(parents=True, exist_ok=True)
-            meta_p.write_text(json.dumps(
+            # tmp+replace+fsync: a crash mid-meta-write must never leave a
+            # truncated .json that query() would half-trust on resume
+            durable.write_replace(meta_p, json.dumps(
                 {"total": total, "digest": bytes(digest).hex(),
-                 "file_info": int(file_info)}, sort_keys=True))
+                 "file_info": int(file_info)}, sort_keys=True).encode())
             bin_p.write_bytes(bytes(data))
         else:
             if not bin_p.exists() or not meta_p.exists():
@@ -583,6 +592,44 @@ class PartialStore:
             except OSError:
                 pass
 
+    def expire(self, ttl_s: Optional[float] = None,
+               now: Optional[float] = None) -> int:
+        """TTL janitor: delete abandoned partials (bin/json pairs — and
+        stray meta ``.tmp`` files from a crashed writer) whose newest
+        member is older than ``ttl_s``.  Returns the number of partial
+        *files* (distinct ids) expired; each bumps
+        ``bkw_partials_expired_total``.  A sender that never returns must
+        not leak receiver quota forever."""
+        ttl = defaults.PARTIAL_STORE_TTL_S if ttl_s is None else float(ttl_s)
+        now = time.time() if now is None else float(now)
+        if not self.base.is_dir():
+            return 0
+        newest: Dict[str, float] = {}
+        members: Dict[str, list] = {}
+        for p in self.base.iterdir():
+            if not p.is_file():
+                continue
+            stem = p.name.split(".", 1)[0]
+            try:
+                mtime = p.stat().st_mtime
+            except OSError:
+                continue
+            newest[stem] = max(newest.get(stem, 0.0), mtime)
+            members.setdefault(stem, []).append(p)
+        expired = 0
+        for stem, latest in newest.items():
+            if now - latest <= ttl:
+                continue
+            for p in members[stem]:
+                try:
+                    p.unlink()
+                except OSError:
+                    pass
+            expired += 1
+        if expired:
+            _PARTIALS_EXPIRED.inc(expired)
+        return expired
+
 
 class _ResumableSinkMixin:
     """Chunked-transfer entry points riding on a writer's ``partials``
@@ -601,8 +648,11 @@ class _ResumableSinkMixin:
         def stage():
             if int(offset) == 0:
                 self._check_part_admission(file_info, file_id, int(total))
-            return self.partials.append(file_info, file_id, offset, total,
-                                        digest, data)
+            faults.crashpoint(_CP_PARTIAL_PRE)
+            out = self.partials.append(file_info, file_id, offset, total,
+                                       digest, data)
+            faults.crashpoint(_CP_PARTIAL_POST)
+            return out
 
         raw = await loop.run_in_executor(None, stage)
         if raw is None:

@@ -31,6 +31,7 @@ from pathlib import Path
 from typing import IO, List, Optional
 
 from .. import defaults
+from ..utils import durable
 from . import metrics as _metrics
 
 
@@ -82,6 +83,9 @@ class Journal:
             self.path.rename(self.path.with_name(self.path.name + ".1"))
         else:
             self.path.unlink()
+        # one barrier for the whole rename chain: a crash mid-rotation may
+        # lose a generation shift but never a committed journal file
+        durable.fsync_dir(self.path.parent)
         self.rotations += 1
 
     def close(self) -> None:
@@ -129,7 +133,7 @@ class Journal:
         tmp = out.with_name(out.name + ".tmp")
         tmp.write_text(json.dumps(doc, sort_keys=True, default=str),
                        encoding="utf-8")
-        tmp.rename(out)
+        durable.commit_replace(tmp, out)
         return out
 
 

@@ -30,6 +30,13 @@ through a scripted sequence of timed phases:
                mid-transfer cuts that force byte-range resumes, peer
                stats seeded so capacity-aware placement avoids the
                placement-demoted slow holder, and probation recovery
+``crash``      the crash matrix: for each armed commit seam the source
+               client's backup dies at that exact instruction
+               (:func:`~backuwup_tpu.utils.faults.crashpoint`), the
+               client is restarted in-process (every in-memory structure
+               discarded, directories re-opened) so the startup recovery
+               sweep reconciles, then a re-run backup must complete and
+               a second ``recover()`` must reconcile zero items
 =============  ============================================================
 
 Everything is seeded (fault plane, corpus bytes, victim choice), so a
@@ -76,6 +83,7 @@ class Phase:
     count: int = 1           # victims for byzantine/kill
     interval_s: float = 0.3  # churn kill/revive cadence
     grow: bool = False       # write fresh corpus files first
+    sites: tuple = ()        # crash: commit seams (() = _CRASH_MATRIX)
     name: str = ""
 
     @property
@@ -102,6 +110,24 @@ class ScenarioSpec:
     expect_violation: bool = False
     expect_final_status: str = "ok"
     min_shards_rebuilt: int = 0
+
+
+#: The sender-side commit seams a scenario backup crosses, i.e. the
+#: default crash matrix (`docs/crash_consistency.md`).  The receiver-side
+#: seam (``partial.sink.*``) and the repair re-home seam
+#: (``repair.rehome.*``) fire in code paths a plain backup never enters;
+#: tests/test_crash.py covers those with targeted unit recoveries.
+_CRASH_MATRIX = (
+    "pack.seal.pre", "pack.seal.post",
+    "challenge.save.pre", "challenge.save.post",
+    "index.save.pre", "index.save.post",
+    "placement.insert.pre", "placement.insert.post",
+    "stripe.finish.pre", "stripe.finish.post",
+)
+
+
+def _crash_count(ph: Phase) -> int:
+    return len(ph.sites or _CRASH_MATRIX)
 
 
 #: defaults shrunk for loopback scenarios; saved/restored around a run.
@@ -177,18 +203,11 @@ class ScenarioHarness:
             db_path=str(self.workdir / "server.db"))
         self.server_port = await self.server.start()
 
-        def make_app(name: str) -> ClientApp:
-            app = ClientApp(config_dir=self.workdir / name / "cfg",
-                            data_dir=self.workdir / name / "data",
-                            server_addr=f"127.0.0.1:{self.server_port}",
-                            backend=self.backend,
-                            tls=False)  # plaintext loopback deployment
-            app.store.set_backup_path(str(self.src))
-            return app
-
-        self.a = make_app("a")
-        self.holders = [make_app(f"h{i}") for i in range(spec.holders)]
-        self.spares = [make_app(f"s{i}") for i in range(spec.spares)]
+        self.a = self._make_app("a")
+        self.holders = [self._make_app(f"h{i}")
+                        for i in range(spec.holders)]
+        self.spares = [self._make_app(f"s{i}")
+                       for i in range(spec.spares)]
         for app in self._apps():
             await app.start()
             # the harness drives audits and sweeps; background schedulers
@@ -208,6 +227,15 @@ class ScenarioHarness:
             peer.store.add_peer_negotiated(self.a.client_id, amount)
             self.server.db.save_storage_negotiated(
                 bytes(self.a.client_id), bytes(peer.client_id), amount)
+
+    def _make_app(self, name: str) -> ClientApp:
+        app = ClientApp(config_dir=self.workdir / name / "cfg",
+                        data_dir=self.workdir / name / "data",
+                        server_addr=f"127.0.0.1:{self.server_port}",
+                        backend=self.backend,
+                        tls=False)  # plaintext loopback deployment
+        app.store.set_backup_path(str(self.src))
+        return app
 
     async def teardown(self) -> None:
         for app in self._apps():
@@ -261,6 +289,8 @@ class ScenarioHarness:
     # --- invariant sampling ------------------------------------------------
 
     def _sample_once(self) -> None:
+        if self.monitor is None:  # crash-phase restart window: no live client
+            return
         rep = self.monitor.sweep()
         self.samples.append({
             "t": round(time.time() - self.t0, 3),
@@ -484,6 +514,65 @@ class ScenarioHarness:
             bytes(slow.client_id)
             not in self.a.store.placement_demoted_peers())
 
+    async def _restart_client(self) -> dict:
+        """Simulate process death + reboot of the source client: throw
+        away every in-memory structure (engine, blob index, store
+        connection) and re-open the same directories — exactly the state
+        a real crash loses — then let ``ClientApp.start``'s recovery
+        sweep reconcile.  Returns that sweep's report."""
+        # null the monitor before the first await: the sampler task shares
+        # this loop and must not sweep the closed store mid-restart
+        self.monitor = None
+        await self.a.stop()
+        app = self._make_app("a")
+        # recover() runs inside start(); it must not spawn a background
+        # repair task — the harness drives every round deterministically
+        app.engine.auto_repair = False
+        await app.start()
+        app._audit_task.cancel()
+        app._monitor_task.cancel()
+        self.a = app
+        self.monitor = app.monitor
+        return app.engine.last_recovery
+
+    async def _phase_crash(self, ph: Phase) -> None:
+        """The crash matrix.  Per seam: grow the corpus, arm the crash
+        point, drive a backup into the injected crash, restart the
+        client, and prove recovery — the re-run backup completes, a
+        second ``recover()`` reconciles zero items (idempotency), and
+        the invariant sweep shows zero violations."""
+        crashes = self.facts.setdefault("crash_sites", [])
+        for site in ph.sites or _CRASH_MATRIX:
+            self._grow()
+            self.plane.arm_crash(site)
+            try:
+                await asyncio.wait_for(self.a.backup(), 180)
+                raise ScenarioError(f"armed crash at {site} never fired")
+            except faults.CrashInjected as e:
+                if e.site != site:
+                    raise ScenarioError(
+                        f"crash fired at {e.site}, armed {site}")
+            report = await self._restart_client()
+            # the drain: the next backup's send loop picks up every
+            # leftover unsent packfile alongside the re-packed blobs
+            snapshot = await asyncio.wait_for(
+                self._retry_busy(lambda: self.a.backup()), 180)
+            if not snapshot:
+                raise ScenarioError(
+                    f"post-crash backup after {site} returned no snapshot")
+            self.facts["backups"] += 1
+            again = await self.a.engine.recover()
+            sweep = self.monitor.sweep()
+            crashes.append({
+                "site": site,
+                "reconciled": report["reconciled"],
+                "backlog": report["packfiles_pending"]
+                + report["stripes_underplaced"],
+                "idempotent": again["reconciled"] == 0,
+                "violations_after": len(sweep.violations),
+            })
+        self.facts["source_digest"] = _tree_digest(self.src)
+
     # --- gates -------------------------------------------------------------
 
     def _assertions(self, error, counters) -> List[sc.Assertion]:
@@ -491,8 +580,10 @@ class ScenarioHarness:
         A = sc.Assertion
         out = [A("phases_completed", error is None,
                  "" if error is None else f"{error[0]}: {error[1]}")]
-        want_backups = sum(1 for p in spec.phases
-                           if p.kind in ("backup", "churn", "race", "wan"))
+        want_backups = sum(
+            _crash_count(p) if p.kind == "crash" else 1
+            for p in spec.phases
+            if p.kind in ("backup", "churn", "race", "wan", "crash"))
         out.append(A("backups_completed",
                      facts["backups"] >= want_backups,
                      f"{facts['backups']}/{want_backups}"))
@@ -561,6 +652,31 @@ class ScenarioHarness:
             out.append(A("placement_demotion_recovered",
                          facts.get("wan_placement_recovered") is True,
                          "probation expiry re-admitted the slow holder"))
+        if any(p.kind == "crash" for p in spec.phases):
+            want = sum(_crash_count(p) for p in spec.phases
+                       if p.kind == "crash")
+            crashes = facts.get("crash_sites", [])
+            injections = sum(
+                v for k, v in counters.items()
+                if k.startswith("bkw_fault_injections_total")
+                and "crash." in k)
+            out.append(A("crashes_injected",
+                         len(crashes) >= want and injections >= want,
+                         f"{len(crashes)}/{want} seams crashed"
+                         f" ({injections:g} injections counted)"))
+            recoveries = sum(
+                v for k, v in counters.items()
+                if k.startswith("bkw_recovery_runs_total"))
+            out.append(A("recoveries_swept", recoveries >= 2 * len(crashes),
+                         f"recovery_runs={recoveries:g} for"
+                         f" {len(crashes)} crash(es)"))
+            # the PR-9 hard gate: every crashed seam recovered to a
+            # violation-free world and a provably idempotent recover()
+            bad = [c["site"] for c in crashes
+                   if not c["idempotent"] or c["violations_after"]]
+            out.append(A("recovery_clean", bool(crashes) and not bad,
+                         "all seams idempotent + violation-free"
+                         if not bad else "dirty: " + ", ".join(bad)))
         return out
 
 
@@ -615,6 +731,17 @@ def builtin_scenarios() -> Dict[str, ScenarioSpec]:
         "wan": ScenarioSpec(
             name="wan", seed=71, corpus_files=4, chunk_bytes=4096,
             phases=(P("wan"), P("restore"))),
+        # crash: a representative seam per commit layer (tier-1);
+        # crash_full walks every sender-side seam (slow matrix)
+        "crash": ScenarioSpec(
+            name="crash", seed=81, corpus_files=4,
+            phases=(P("backup"),
+                    P("crash", sites=("pack.seal.pre", "index.save.pre",
+                                      "placement.insert.post")),
+                    P("restore"))),
+        "crash_full": ScenarioSpec(
+            name="crash_full", seed=91, corpus_files=4,
+            phases=(P("backup"), P("crash"), P("restore"))),
         "full": ScenarioSpec(
             name="full", seed=61, spares=2, corpus_files=10,
             corpus_file_bytes=48 * 1024, min_shards_rebuilt=1,

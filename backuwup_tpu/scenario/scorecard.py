@@ -64,6 +64,12 @@ COUNTER_FAMILIES = (
     "bkw_transfer_stalls_total",
     "bkw_transfer_bytes_resent_total",
     "bkw_placement_demotions_total",
+    # crash-consistency plane (PR 9): startup recovery sweeps, what each
+    # sweep reconciled, and the receiver-side partial janitor — the
+    # recovery_clean gate's evidence trail
+    "bkw_recovery_runs_total",
+    "bkw_recovery_items_total",
+    "bkw_partials_expired_total",
 )
 
 #: Histogram families quantiled in the card.
@@ -74,6 +80,7 @@ HISTOGRAM_FAMILIES = (
     "bkw_pack_stage_seconds",
     "bkw_peer_transfer_wait_seconds",
     "bkw_peer_transfer_send_seconds",
+    "bkw_recovery_seconds",
 )
 
 

@@ -33,12 +33,19 @@ except ModuleNotFoundError:  # containers without the wheel: libcrypto shim
 
 from .. import defaults
 from ..crypto import KeyManager
+from ..utils import durable, faults
 from ..utils.serialization import Reader, Writer
 from ..wire import AUDIT_NONCE_LEN, BLOB_HASH_LEN, PACKFILE_ID_LEN
 
 INDEX_KEY_INFO = b"index"
 CHALLENGE_KEY_INFO = b"audit"
 _NAME_RE = re.compile(r"^\d{6}$")
+
+# Crash-matrix seams: the window either side of each durable commit.
+_CP_CHALLENGE_PRE = faults.register_crash_site("challenge.save.pre")
+_CP_CHALLENGE_POST = faults.register_crash_site("challenge.save.post")
+_CP_INDEX_PRE = faults.register_crash_site("index.save.pre")
+_CP_INDEX_POST = faults.register_crash_site("index.save.post")
 
 
 def index_file_name(counter: int) -> str:
@@ -107,7 +114,9 @@ class ChallengeTable:
         self.table_dir.mkdir(parents=True, exist_ok=True)
         tmp = path.with_suffix(".tmp")
         tmp.write_bytes(ct)
-        os.replace(tmp, path)
+        faults.crashpoint(_CP_CHALLENGE_PRE)
+        durable.commit_replace(tmp, path)
+        faults.crashpoint(_CP_CHALLENGE_POST)
         return path
 
     def load(self, packfile_id: bytes) -> List[ChallengeEntry]:
@@ -142,8 +151,10 @@ class BlobIndex:
     def _scan_next_file(self) -> int:
         if not self.index_dir.is_dir():
             return 0
-        numbers = [int(p.name) for p in self.index_dir.iterdir()
-                   if _NAME_RE.match(p.name)]
+        # a crashed flush leaves NNNNNN.tmp behind: that counter's nonce
+        # already encrypted one plaintext, so it is burned either way
+        numbers = [int(p.name.split(".")[0]) for p in self.index_dir.iterdir()
+                   if _NAME_RE.match(p.name.split(".")[0])]
         return max(numbers) + 1 if numbers else 0
 
     # --- dedup contract (blob_index.rs:130-148) ----------------------------
@@ -238,7 +249,9 @@ class BlobIndex:
             path = self.index_dir / index_file_name(self._next_file)
             tmp = path.with_suffix(".tmp")
             tmp.write_bytes(ct)
-            os.replace(tmp, path)
+            faults.crashpoint(_CP_INDEX_PRE)
+            durable.commit_replace(tmp, path)
+            faults.crashpoint(_CP_INDEX_POST)
             written.append(path)
             self._next_file += 1
         return written

@@ -44,7 +44,7 @@ except ModuleNotFoundError:  # containers without the wheel: libcrypto shim
 from .. import defaults
 from ..crypto import KeyManager
 from ..obs import metrics as obs_metrics
-from ..utils import zstd
+from ..utils import durable, faults, zstd
 from ..utils.serialization import Reader, Writer
 from ..wire import (
     PACKFILE_ID_LEN,
@@ -55,6 +55,10 @@ from ..wire import (
 
 HEADER_KEY_INFO = b"header"
 NONCE_LEN = 12
+
+# Crash-matrix seams around the packfile seal commit (docs/crash_consistency.md)
+_CP_SEAL_PRE = faults.register_crash_site("pack.seal.pre")
+_CP_SEAL_POST = faults.register_crash_site("pack.seal.post")
 
 _STAGE_SECONDS = obs_metrics.histogram(
     "bkw_pack_stage_seconds",
@@ -322,7 +326,9 @@ class PackfileWriter:
             f.write(header_ct)
             for p in pendings:
                 f.write(p.record)
-        os.replace(tmp, path)
+        faults.crashpoint(_CP_SEAL_PRE)
+        durable.commit_replace(tmp, path)
+        faults.crashpoint(_CP_SEAL_POST)
         size = path.stat().st_size
         dt = time.monotonic() - t0
         with self._stats_lock:

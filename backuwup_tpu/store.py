@@ -166,6 +166,12 @@ class Store:
         self._lock = threading.RLock()
         self._db = sqlite3.connect(self.dir / "config.db",
                                    check_same_thread=False)
+        # same crash discipline as the server DB (net/server.py): WAL keeps
+        # a mid-transaction process death from corrupting placements/peer
+        # state; NORMAL syncs the WAL at checkpoint, plenty for a client
+        # whose DB can be re-derived from the server plus its own disk
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
         self._db.executescript(_SCHEMA)
         # erasure-era column on pre-existing databases; -1 = whole packfile
         # (the CREATE above already carries it for fresh stores)

@@ -23,6 +23,18 @@ Transport/Node seam in :mod:`backuwup_tpu.net.p2p` start injecting
   protocol (docs/transfer.md) must continue from the persisted offset.
 * **flaky reconnect** — ``reconnect_fail`` makes a fraction of p2p dials
   fail outright, the residential-NAT reconnect lottery.
+* **crash points** — named :func:`crashpoint` sites at every multi-step
+  commit seam (pack-seal, blob-index save, challenge-table save,
+  placement insert, stripe finish, repair re-home, partial sink).  When
+  armed (``arm_crash`` exact, or the seeded ``crash`` rate) the site
+  raises :class:`CrashInjected` — deliberately a ``BaseException`` so no
+  blanket ``except Exception`` recovery path can absorb the "process
+  died here" signal — or, with ``crash_hard`` set (subprocess mode),
+  hard-exits via ``os._exit`` with :data:`CRASH_EXIT_CODE`, the closest
+  in-tree approximation of ``kill -9`` at that instruction.  Sites
+  self-register through :func:`register_crash_site` at import, so the
+  crash-matrix harness can enumerate :func:`crash_sites` without a
+  hand-kept list.
 
 Two properties the acceptance bar demands, by construction:
 
@@ -53,6 +65,42 @@ from ..obs import metrics as obs_metrics
 ACT_DROP = "drop"
 ACT_CORRUPT = "corrupt"
 
+#: Process exit status used by hard crash injection (``crash_hard``) so a
+#: supervising test can tell an injected crash from a real fault.
+CRASH_EXIT_CODE = 70
+
+
+class CrashInjected(BaseException):
+    """The process "died" at a named crash point.
+
+    Derives from ``BaseException`` on purpose: the commit seams sit under
+    broad ``except Exception`` guards (challenge-table save, send jobs)
+    that must NOT be able to swallow an injected crash — a real power cut
+    would not have run those handlers either.
+    """
+
+    def __init__(self, site: str):
+        super().__init__(site)
+        self.site = site
+
+
+#: Every crash-point name ever registered in this process, in module
+#: import order of the seams.  The crash-matrix harness enumerates this.
+CRASH_SITES: Set[str] = set()
+
+
+def register_crash_site(site: str) -> str:
+    """Declare a crash point at module import; returns ``site`` so call
+    sites can bind it to a constant: ``_CP = faults.register_crash_site(
+    "pack.seal.pre")``."""
+    CRASH_SITES.add(site)
+    return site
+
+
+def crash_sites() -> tuple:
+    """Sorted tuple of every registered crash point (matrix input)."""
+    return tuple(sorted(CRASH_SITES))
+
 _INJECTIONS = obs_metrics.counter(
     "bkw_fault_injections_total", "Fault-plane firings by hook site",
     ("site",))
@@ -82,7 +130,8 @@ class FaultPlane:
     def __init__(self, seed: int = 0, *, drop_send: float = 0.0,
                  corrupt_frame: float = 0.0, withhold_ack: float = 0.0,
                  latency: float = 0.0, latency_s: float = 0.05,
-                 cut_part: float = 0.0, reconnect_fail: float = 0.0):
+                 cut_part: float = 0.0, reconnect_fail: float = 0.0,
+                 crash: float = 0.0, crash_hard: bool = False):
         self.seed = int(seed)
         self.drop_send = float(drop_send)
         self.corrupt_frame = float(corrupt_frame)
@@ -91,6 +140,8 @@ class FaultPlane:
         self.latency_s = float(latency_s)
         self.cut_part = float(cut_part)
         self.reconnect_fail = float(reconnect_fail)
+        self.crash = float(crash)
+        self.crash_hard = bool(crash_hard)
         self.dead: Set[bytes] = set()
         self._cuts: Dict[bytes, Set[int]] = {}
         self._kill_after: Dict[bytes, int] = {}
@@ -129,6 +180,32 @@ class FaultPlane:
             self.fired[site] = self.fired.get(site, 0) + 1
             _record_injection(site)
         return hit
+
+    # --- crash points -------------------------------------------------------
+
+    def arm_crash(self, site: str, *query_indices: int) -> None:
+        """Arm crash point ``site`` (a :data:`CRASH_SITES` name) to fire
+        on the given 0-based query indices — the first query when none
+        are given.  The deterministic crash-matrix API."""
+        self.arm(f"crash.{site}", *(query_indices or (0,)))
+
+    def crashpoint(self, site: str) -> None:
+        """One pass through crash point ``site``.  Free unless the crash
+        kind is active (armed or rated); fires at most what
+        :meth:`decide` says; raises :class:`CrashInjected`, or hard-exits
+        the process when ``crash_hard`` is set."""
+        key = f"crash.{site}"
+        if self.crash <= 0.0 and key not in self._armed:
+            return
+        if not self.decide(key, self.crash):
+            return
+        if self.crash_hard:
+            try:
+                obs_journal.emit("crash_injected", site=site, hard=True)
+            except Exception:
+                pass
+            os._exit(CRASH_EXIT_CODE)
+        raise CrashInjected(site)
 
     # --- peer death ---------------------------------------------------------
 
@@ -241,13 +318,28 @@ def uninstall() -> None:
     PLANE = None
 
 
+def crashpoint(site: str) -> None:
+    """The module-level crash hook the commit seams call.  One attribute
+    load when no plane is installed — same inertness contract as every
+    other hook site."""
+    plane = PLANE
+    if plane is not None:
+        plane.crashpoint(site)
+
+
 def from_env(spec: Optional[str] = None) -> Optional[FaultPlane]:
     """Parse a ``BKW_FAULTS`` spec into a plane (None when unset/empty).
 
     Format: comma-separated ``key=value``; keys ``seed``, ``drop_send``,
     ``corrupt_frame``, ``withhold_ack``, ``latency`` (probability),
-    ``latency_s`` (seconds), ``kill`` ('+'-separated hex client ids).
+    ``latency_s`` (seconds), ``kill`` ('+'-separated hex client ids),
+    ``crash`` ('+'-separated crash sites, each optionally ``site@N`` to
+    fire on the Nth query instead of the first), ``crash_rate``
+    (probability across every crash point) and ``crash_hard`` (0/1:
+    convert an injected crash into a hard ``os._exit`` — the subprocess
+    kill -9 mode).
     Example: ``BKW_FAULTS=seed=7,drop_send=0.05,latency=0.2,latency_s=0.1``
+    or ``BKW_FAULTS=crash=placement.insert.post@1,crash_hard=1``
     """
     spec = os.environ.get("BKW_FAULTS", "") if spec is None else spec
     spec = spec.strip()
@@ -255,6 +347,8 @@ def from_env(spec: Optional[str] = None) -> Optional[FaultPlane]:
         return None
     kw: Dict[str, float] = {}
     kills = []
+    crashes = []
+    crash_hard = False
     for part in spec.split(","):
         if not part.strip():
             continue
@@ -265,15 +359,27 @@ def from_env(spec: Optional[str] = None) -> Optional[FaultPlane]:
             kills.extend(bytes.fromhex(v) for v in value.split("+") if v)
         elif key == "seed":
             kw["seed"] = int(value)
+        elif key == "crash":
+            for v in value.split("+"):
+                if not v:
+                    continue
+                site, _, at = v.partition("@")
+                crashes.append((site, int(at) if at else 0))
+        elif key == "crash_rate":
+            kw["crash"] = float(value)
+        elif key == "crash_hard":
+            crash_hard = value.lower() not in ("", "0", "false", "no")
         elif key in ("drop_send", "corrupt_frame", "withhold_ack",
                      "latency", "latency_s", "cut_part", "reconnect_fail"):
             kw[key] = float(value)
         else:
             raise ValueError(f"unknown BKW_FAULTS key {key!r}")
     seed = int(kw.pop("seed", 0))
-    plane = FaultPlane(seed, **kw)
+    plane = FaultPlane(seed, crash_hard=crash_hard, **kw)
     for k in kills:
         plane.kill(k)
+    for site, at in crashes:
+        plane.arm_crash(site, at)
     return plane
 
 

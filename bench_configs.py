@@ -883,6 +883,46 @@ def config9_scenario(log: Callable) -> Dict:
             "scorecard": card.to_dict()}
 
 
+def config11_crash(log: Callable) -> Dict:
+    """Crash matrix + recovery sweep cost — config #11.
+
+    Runs the representative ``crash`` scenario (three armed commit-seam
+    crashes mid-backup, each followed by a client restart, the startup
+    recovery sweep, a drain re-backup, and an idempotence probe) and
+    reports what crash recovery COSTS: sweeps run, items reconciled by
+    category, and the sweep wall-time quantiles — with the full
+    scorecard embedded so the ``recovery_clean`` hard gate regresses
+    loudly in the BENCH record.
+    """
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    from backuwup_tpu.scenario import builtin_scenarios, run_scenario
+
+    spec = builtin_scenarios()["crash"]
+    with tempfile.TemporaryDirectory(prefix="bkw_bench_crash_") as td:
+        card = asyncio.run(run_scenario(spec, Path(td)))
+    counters = card.counters
+    sweeps = sum(v for k, v in counters.items()
+                 if k.startswith("bkw_recovery_runs_total"))
+    items = {k.split("category=", 1)[1].rstrip("}"): v
+             for k, v in counters.items()
+             if k.startswith("bkw_recovery_items_total")}
+    sweep_q = next((v for k, v in card.quantiles.items()
+                    if k.startswith("bkw_recovery_seconds")), {})
+    log(f"config#11 crash '{card.scenario}' (seed {card.seed}): "
+        f"{'PASS' if card.passed else 'FAIL'} in {card.elapsed_s:.1f}s, "
+        f"sweeps={sweeps:g} reconciled={sum(items.values()):g} "
+        f"sweep_p99={sweep_q.get('p99')}s")
+    return {"passed": card.passed,
+            "recovery_sweeps": int(sweeps),
+            "items_reconciled": items,
+            "sweep_seconds": sweep_q,
+            "wall_s": round(card.elapsed_s, 2),
+            "scorecard": card.to_dict()}
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -897,7 +937,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("7_erasure", lambda: config7_erasure(log)),
             ("8_transfer", lambda: config8_transfer(log)),
             ("9_scenario", lambda: config9_scenario(log)),
-            ("10_wan", lambda: config10_wan(log))):
+            ("10_wan", lambda: config10_wan(log)),
+            ("11_crash", lambda: config11_crash(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

@@ -75,6 +75,19 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "wan bench recapture FAILED (see $wan) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated crash-matrix recapture: config #11 alone (host-only
+        # crash scenario: armed commit-seam crashes, restart + recovery
+        # sweep per seam) — the recovery-cost numbers and the
+        # recovery_clean gate verdict survive even when the device suite
+        # timed out partway
+        crs="$BENCH_OUT_DIR/BENCH_crash_${stamp}.json"
+        if timeout "${BENCH_CRASH_TIMEOUT_S:-600}" \
+                env BENCH_ONLY_CONFIG=11_crash BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$crs" 2>>/tmp/tpu_watch.log; then
+            echo "crash bench recaptured to $crs at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "crash bench recapture FAILED (see $crs) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

@@ -63,6 +63,11 @@ def pytest_configure(config):
         " (scenario/harness.py); the fast seeded ones are tier-1, the"
         " full matrix is also marked slow")
     config.addinivalue_line(
+        "markers", "crash: crash-consistency tests (deterministic crash"
+        " injection + startup recovery sweep, docs/crash_consistency.md);"
+        " the unit recoveries and the representative scenario subset are"
+        " tier-1, the full matrix and the kill-9 e2e are also slow")
+    config.addinivalue_line(
         "markers", "profile: timing-sensitive profiling tests"
         " (obs/profile.py dev timer); excluded from tier-1 like accel —"
         " set BKW_PROFILE_TESTS=1 to run them")

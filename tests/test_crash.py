@@ -1,0 +1,615 @@
+"""Crash-consistency plane: injection, per-seam recovery, the matrix.
+
+Deterministic crash injection (utils/faults.py ``crashpoint`` sites) and
+the startup recovery sweep (``Engine.recover``) are the two halves of
+docs/crash_consistency.md; this module pins both ends of the contract:
+
+* the crash plane itself — site registry completeness, inert-by-default,
+  exact arming, ``BaseException`` semantics, ``BKW_FAULTS`` parsing;
+* the durable-commit helpers and the config DB's WAL pragmas;
+* per-seam unit recoveries: debris planted exactly as a crash at each
+  commit point leaves it, then ``recover()`` — which must reconcile on
+  the first run and reconcile ZERO items on the second (idempotence);
+* the composed crash-matrix scenario (representative seams tier-1, the
+  full sender-side matrix slow);
+* a subprocess kill-9 e2e: a real client process hard-exits at an armed
+  seam mid-backup (``crash_hard`` → ``os._exit(70)``), restarts, sweeps,
+  re-backs-up, and restores byte-identical data.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from backuwup_tpu import defaults, wire
+from backuwup_tpu.crypto import KeyManager
+from backuwup_tpu.engine import Engine
+from backuwup_tpu.net.p2p import PartialStore, ReceivedFilesWriter
+from backuwup_tpu.obs import journal as obs_journal
+from backuwup_tpu.obs import metrics as obs_metrics
+from backuwup_tpu.ops.blake3_cpu import blake3_hash
+from backuwup_tpu.scenario import builtin_scenarios, run_scenario
+from backuwup_tpu.snapshot.blob_index import (BlobIndex, ChallengeEntry,
+                                              ChallengeTable,
+                                              index_file_name)
+from backuwup_tpu.snapshot.packfile import (PackfileReader, PackfileWriter,
+                                            packfile_path)
+from backuwup_tpu.store import Store
+from backuwup_tpu.utils import durable, faults
+from backuwup_tpu.wire import Blob, BlobKind
+
+pytestmark = pytest.mark.crash
+
+KEYS = KeyManager.from_secret(bytes(range(32)))
+
+#: Every commit seam the plane must know about (importing engine / p2p /
+#: snapshot above registers them all; a seam added without registration
+#: would escape the crash matrix, which is exactly what this test is for).
+EXPECTED_SITES = {
+    "challenge.save.pre", "challenge.save.post",
+    "index.save.pre", "index.save.post",
+    "pack.seal.pre", "pack.seal.post",
+    "partial.sink.pre", "partial.sink.post",
+    "placement.insert.pre", "placement.insert.post",
+    "repair.rehome.pre", "repair.rehome.post",
+    "stripe.finish.pre", "stripe.finish.post",
+}
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    obs_metrics.registry().reset()
+    yield
+    obs_metrics.registry().reset()
+    obs_journal.uninstall()
+    faults.uninstall()
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture
+def plane():
+    return faults.install(faults.FaultPlane(seed=7))
+
+
+def _blob(data: bytes, kind=BlobKind.FILE_CHUNK) -> Blob:
+    return Blob(hash=blake3_hash(data), kind=kind, data=data)
+
+
+def _mk_engine(tmp_path):
+    store = Store(tmp_path / "cfg", data_base=tmp_path / "data")
+    engine = Engine(KEYS, store, None, None)
+    # recover() would otherwise spawn a background repair round for any
+    # drain backlog; these unit tests drive every sweep themselves
+    engine.auto_repair = False
+    return engine, store
+
+
+def _write_packfile(out_dir):
+    """One real sealed packfile on disk; returns (pid, path, hashes)."""
+    written = []
+    w = PackfileWriter(KEYS, out_dir,
+                       on_packfile=lambda pid, path, hashes, size:
+                       written.append((pid, path, hashes)))
+    w.add_blob(_blob(b"crash test payload " * 64))
+    w.flush()
+    w.close()
+    return written[0]
+
+
+# --- the injection plane ---------------------------------------------------
+
+
+def test_crash_site_registry_enumerates_every_commit_seam():
+    sites = faults.crash_sites()
+    assert EXPECTED_SITES <= set(sites)
+    assert list(sites) == sorted(sites)  # stable matrix input
+
+
+def test_crashpoint_is_inert_without_a_plane_or_arming():
+    faults.uninstall()
+    faults.crashpoint("pack.seal.pre")  # no plane: pure no-op
+    plane = faults.install(faults.FaultPlane(seed=1))
+    faults.crashpoint("pack.seal.pre")  # plane but nothing armed
+    assert plane.fired == {}
+
+
+def test_armed_crashpoint_fires_once_with_site_and_accounting(plane):
+    plane.arm_crash("pack.seal.pre")
+    with pytest.raises(faults.CrashInjected) as e:
+        faults.crashpoint("pack.seal.pre")
+    assert e.value.site == "pack.seal.pre"
+    assert plane.fired["crash.pack.seal.pre"] == 1
+    # one-shot: the armed index is consumed, later passes are clean
+    faults.crashpoint("pack.seal.pre")
+    assert plane.fired["crash.pack.seal.pre"] == 1
+    snap = obs_metrics.registry().snapshot()
+    series = snap["bkw_fault_injections_total"]["series"]
+    assert any(s["labels"].get("site") == "crash.pack.seal.pre"
+               and s["value"] == 1 for s in series)
+
+
+def test_crash_injected_outruns_blanket_exception_guards(plane):
+    assert not issubclass(faults.CrashInjected, Exception)
+    plane.arm_crash("index.save.pre")
+    with pytest.raises(faults.CrashInjected):
+        try:
+            faults.crashpoint("index.save.pre")
+        except Exception:  # the guard a real power cut never runs
+            pytest.fail("except Exception swallowed the injected crash")
+
+
+def test_from_env_parses_crash_specs():
+    plane = faults.from_env(
+        "seed=3,crash=placement.insert.post@1+pack.seal.pre,crash_hard=1")
+    assert plane.crash_hard
+    assert plane._armed["crash.placement.insert.post"] == {1}
+    assert plane._armed["crash.pack.seal.pre"] == {0}
+    rated = faults.from_env("crash_rate=0.5")
+    assert rated.crash == 0.5 and not rated.crash_hard
+    assert faults.from_env("") is None
+    with pytest.raises(ValueError):
+        faults.from_env("crash_everything=1")
+
+
+# --- durable-commit helpers + DB pragmas -----------------------------------
+
+
+def test_write_replace_commits_atomically_without_tmp_debris(tmp_path):
+    dst = tmp_path / "state.bin"
+    durable.write_replace(dst, b"v1")
+    assert dst.read_bytes() == b"v1"
+    durable.write_replace(dst, b"v2")
+    assert dst.read_bytes() == b"v2"
+    assert list(tmp_path.glob("*.tmp")) == []
+
+
+def test_commit_replace_moves_tmp_over_destination(tmp_path):
+    tmp, dst = tmp_path / "x.tmp", tmp_path / "x"
+    dst.write_bytes(b"old")
+    tmp.write_bytes(b"new")
+    durable.commit_replace(tmp, dst)
+    assert dst.read_bytes() == b"new"
+    assert not tmp.exists()
+
+
+def test_config_db_runs_wal_with_normal_sync(tmp_path):
+    store = Store(tmp_path / "cfg", data_base=tmp_path / "data")
+    try:
+        mode, = store._db.execute("PRAGMA journal_mode").fetchone()
+        assert mode.lower() == "wal"
+        sync, = store._db.execute("PRAGMA synchronous").fetchone()
+        assert int(sync) == 1  # NORMAL
+    finally:
+        store.close()
+
+
+# --- per-seam commit windows -----------------------------------------------
+
+
+def test_challenge_save_crash_windows(plane, tmp_path):
+    ct = ChallengeTable(KEYS, tmp_path)
+    entries = [ChallengeEntry(0, 16, b"\x01" * wire.AUDIT_NONCE_LEN,
+                              b"\x02" * 32)]
+    pid = bytes(wire.PACKFILE_ID_LEN)
+    plane.arm_crash("challenge.save.pre")
+    with pytest.raises(faults.CrashInjected):
+        ct.save(pid, entries)
+    # pre-commit crash: nothing published, only the tmp the sweep deletes
+    assert not ct.has(pid)
+    tmp = ct.path(pid).with_suffix(".tmp")
+    assert tmp.is_file()
+    tmp.unlink()  # what recover()'s tmp sweep does
+    ct.save(pid, entries)  # the retry after recovery commits cleanly
+    got = ct.load(pid)
+    assert [(e.offset, e.length) for e in got] == [(0, 16)]
+
+    pid2 = b"\x01" * wire.PACKFILE_ID_LEN
+    plane.arm_crash("challenge.save.post")
+    with pytest.raises(faults.CrashInjected):
+        ct.save(pid2, entries)
+    # post-commit crash: the table IS durable, nothing to redo
+    assert ct.has(pid2)
+    assert len(ct.load(pid2)) == 1
+
+
+def test_blob_index_crash_burns_the_tmp_counter_nonce(plane, tmp_path):
+    idx_dir = tmp_path / "index"
+    idx = BlobIndex(KEYS, idx_dir)
+    idx.finalize_packfile(b"\x01" * wire.PACKFILE_ID_LEN, [b"\xaa" * 32])
+    plane.arm_crash("index.save.pre")
+    with pytest.raises(faults.CrashInjected):
+        idx.flush()
+    # the tmp for counter 0 is on disk; the commit never happened
+    assert (idx_dir / (index_file_name(0) + ".tmp")).is_file()
+    assert not (idx_dir / index_file_name(0)).is_file()
+    # a restarted index must NOT reuse counter 0: the counter is the
+    # AES-GCM nonce, and the crashed tmp may already hold ciphertext
+    idx2 = BlobIndex(KEYS, idx_dir)
+    assert idx2.load() == 0
+    idx2.finalize_packfile(b"\x02" * wire.PACKFILE_ID_LEN, [b"\xbb" * 32])
+    written = idx2.flush()
+    assert [p.name for p in written] == [index_file_name(1)]
+    idx3 = BlobIndex(KEYS, idx_dir)
+    assert idx3.load() == 1
+
+
+def test_pack_seal_crash_leaves_only_tmp_debris(plane, tmp_path):
+    w = PackfileWriter(KEYS, tmp_path / "pack")
+    w.add_blob(_blob(b"doomed bytes"))
+    plane.arm_crash("pack.seal.pre")
+    with pytest.raises(faults.CrashInjected):
+        w.flush()
+    files = [p for p in (tmp_path / "pack").rglob("*") if p.is_file()]
+    assert files and all(p.suffix == ".tmp" for p in files)
+    w.shutdown()
+
+
+# --- Engine.recover(): per-seam unit recoveries ----------------------------
+
+
+def test_recover_cleans_planted_debris_and_is_idempotent(tmp_path, loop):
+    engine, store = _mk_engine(tmp_path)
+    try:
+        # crashed tmp+replace commits in all three commit directories
+        for d, name in ((store.index_dir(), "000004.tmp"),
+                        (store.challenge_dir(), "ab12.tmp"),
+                        (engine._pack_dir() / "ab", "cd34.tmp")):
+            d.mkdir(parents=True, exist_ok=True)
+            (d / name).write_bytes(b"torn")
+        # half-staged repair and restore trees
+        for staging in (store.data_base / "repair_staging",
+                        store.restore_dir()):
+            staging.mkdir(parents=True, exist_ok=True)
+            (staging / "half.bin").write_bytes(b"x")
+        # an abandoned inbound partial, older than the TTL
+        part = store.received_dir(b"\x11" * 32) / "partial"
+        part.mkdir(parents=True, exist_ok=True)
+        old = time.time() - defaults.PARTIAL_STORE_TTL_S - 60
+        for name in ("ff00.bin", "ff00.json"):
+            (part / name).write_bytes(b"{}")
+            os.utime(part / name, (old, old))
+
+        rep = loop.run_until_complete(engine.recover())
+        assert rep["tmp_cleaned"] == 3
+        assert rep["staging_cleared"] == 2
+        assert rep["partials_expired"] == 1
+        assert rep["reconciled"] == 6
+        assert engine.last_recovery is rep
+
+        again = loop.run_until_complete(engine.recover())
+        assert again["reconciled"] == 0
+
+        snap = obs_metrics.registry().snapshot()
+        runs = snap["bkw_recovery_runs_total"]["series"]
+        assert sum(s["value"] for s in runs) == 2
+        cats = {s["labels"]["category"]: s["value"]
+                for s in snap["bkw_recovery_items_total"]["series"]}
+        assert cats["tmp_cleaned"] == 3 and cats["partials_expired"] == 1
+    finally:
+        store.close()
+
+
+def test_recover_adopts_verified_packfiles_the_index_never_named(
+        tmp_path, loop):
+    engine, store = _mk_engine(tmp_path)
+    try:
+        # a crash after pack.seal.post but before the index flush: the
+        # sealed file exists, the on-disk index has never heard of it
+        pid, _path, hashes = _write_packfile(engine._pack_dir())
+        rep = loop.run_until_complete(engine.recover())
+        assert rep["packfiles_adopted"] == 1
+        assert rep["packfiles_pending"] == 1  # still unsent: drain backlog
+        assert engine.index.lookup(hashes[0]) == bytes(pid)
+        # the adoption was flushed: a fresh index sees it too
+        fresh = BlobIndex(KEYS, store.index_dir())
+        assert fresh.load() >= 1
+        assert bytes(pid) in fresh.packfile_ids()
+
+        again = loop.run_until_complete(engine.recover())
+        assert again["packfiles_adopted"] == 0
+        assert again["reconciled"] == 0
+    finally:
+        store.close()
+
+
+def test_recover_drops_torn_packfiles(tmp_path, loop):
+    engine, store = _mk_engine(tmp_path)
+    try:
+        pid = b"\x5a" * wire.PACKFILE_ID_LEN
+        path = packfile_path(engine._pack_dir(), pid)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_bytes(b"\x00" * 64)  # a torn seal: header never decrypts
+        rep = loop.run_until_complete(engine.recover())
+        assert rep["packfiles_corrupt"] == 1
+        assert not path.exists()
+        assert loop.run_until_complete(engine.recover())["reconciled"] == 0
+    finally:
+        store.close()
+
+
+def test_recover_retires_unreachable_placements(tmp_path, loop):
+    engine, store = _mk_engine(tmp_path)
+    try:
+        # a placement row whose packfile neither the index nor the local
+        # disk can name: the blob mapping died with the crashed process
+        store.record_placement(b"\x6b" * wire.PACKFILE_ID_LEN,
+                               b"\x22" * 32, 4096, shard_index=0)
+        rep = loop.run_until_complete(engine.recover())
+        assert rep["placements_retired"] == 1
+        assert store.all_placements() == []
+        assert loop.run_until_complete(engine.recover())["reconciled"] == 0
+    finally:
+        store.close()
+
+
+def test_recover_completes_fully_placed_packfiles(tmp_path, loop):
+    engine, store = _mk_engine(tmp_path)
+    try:
+        # crash between the last placement ack and the local unlink: every
+        # byte is on a peer, only the local cleanup was lost
+        pid, path, hashes = _write_packfile(engine._pack_dir())
+        engine.index.finalize_packfile(pid, hashes)
+        engine.index.flush()
+        store.record_placement(pid, b"\x33" * 32, path.stat().st_size,
+                               shard_index=-1)
+        rep = loop.run_until_complete(engine.recover())
+        assert rep["packfiles_completed"] == 1
+        assert not path.exists()
+        assert len(store.all_placements()) == 1  # the ack stays recorded
+        assert loop.run_until_complete(engine.recover())["reconciled"] == 0
+    finally:
+        store.close()
+
+
+def test_partial_sink_crash_debris_and_ttl_janitor(plane, tmp_path, loop):
+    store = Store(tmp_path / "cfg", data_base=tmp_path / "data")
+    try:
+        store.set_obfuscation_key(b"\x01\x02\x03\x04")
+        peer = b"\x42" * 32
+        store.add_peer_negotiated(peer, 1 << 20)
+        writer = ReceivedFilesWriter(store, peer)
+        data = b"w" * 1024
+        part0 = dict(file_info=wire.FileInfoKind.PACKFILE,
+                     file_id=b"\x05" * wire.PACKFILE_ID_LEN,
+                     data=data[:512], offset=0, total=len(data),
+                     digest=blake3_hash(data))
+
+        plane.arm_crash("partial.sink.pre")
+        with pytest.raises(faults.CrashInjected):
+            loop.run_until_complete(writer.sink_part(**part0))
+        # pre-append crash: nothing staged, the sender restarts from 0
+        assert not list(writer.partials.base.glob("*.bin"))
+
+        plane.arm_crash("partial.sink.post")
+        with pytest.raises(faults.CrashInjected):
+            loop.run_until_complete(writer.sink_part(**part0))
+        # post-append crash: the staged prefix survives for resume...
+        assert len(list(writer.partials.base.glob("*.bin"))) == 1
+        # ...but an abandoned one is the TTL janitor's to reclaim
+        old = time.time() - defaults.PARTIAL_STORE_TTL_S - 60
+        for p in writer.partials.base.iterdir():
+            os.utime(p, (old, old))
+        assert writer.partials.expire() == 1
+        assert not list(writer.partials.base.iterdir())
+        assert writer.partials.expire() == 0
+        snap = obs_metrics.registry().snapshot()
+        expired = snap["bkw_partials_expired_total"]["series"]
+        assert sum(s["value"] for s in expired) == 1
+    finally:
+        store.close()
+
+
+# --- the crash-matrix scenario ---------------------------------------------
+
+
+@pytest.mark.scenario
+def test_crash_scenario_recovers_representative_seams(tmp_path, loop):
+    """Three representative commit seams (pack seal, index save, placement
+    insert) crash mid-backup; each must recover idempotently with zero
+    invariant violations and the final restore must be byte-for-byte."""
+    card = loop.run_until_complete(
+        run_scenario(builtin_scenarios()["crash"], tmp_path))
+    assert card.passed, card.render()
+    gates = {a.name: a.passed for a in card.assertions}
+    assert gates["crashes_injected"] and gates["recovery_clean"]
+    runs = sum(v for k, v in card.counters.items()
+               if k.startswith("bkw_recovery_runs_total"))
+    assert runs >= 6  # one sweep per restart + one idempotence probe each
+    assert card.invariants["violation_seconds"] == 0
+    assert card.invariants["final"]["status"] == "ok"
+
+
+@pytest.mark.scenario
+@pytest.mark.slow
+def test_crash_scenario_full_sender_matrix(tmp_path, loop):
+    card = loop.run_until_complete(
+        run_scenario(builtin_scenarios()["crash_full"], tmp_path))
+    assert card.passed, card.render()
+
+
+# --- subprocess kill-9 e2e -------------------------------------------------
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(args, extra_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("BKW_FAULTS", None)
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.Popen(
+        [sys.executable, "-m", "backuwup_tpu", *args], cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        bufsize=1)
+
+
+def _reader(proc):
+    import queue
+    if getattr(proc, "_line_queue", None) is None:
+        q = queue.Queue()
+
+        def pump():
+            for line in proc.stdout:
+                q.put(line)
+            q.put(None)
+
+        threading.Thread(target=pump, daemon=True).start()
+        proc._line_queue = q
+    return proc._line_queue
+
+
+def _wait_line(proc, needle: str, timeout: float = 120) -> str:
+    import queue
+    deadline = time.monotonic() + timeout
+    q = _reader(proc)
+    lines = []
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        try:
+            line = q.get(timeout=remaining)
+        except queue.Empty:
+            break
+        if line is None:
+            raise AssertionError(
+                f"process exited before {needle!r}:\n{''.join(lines)}")
+        lines.append(line)
+        if needle in line:
+            return line
+    raise AssertionError(f"timeout waiting for {needle!r}:\n{''.join(lines)}")
+
+
+def _stop(proc):
+    if proc is not None and proc.poll() is None:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(15)
+
+
+def _ws_url(dash_line: str) -> str:
+    return dash_line.rsplit("at ", 1)[1].strip().rstrip("/") + "/ws"
+
+
+async def _start_backups_until_crash(ws_a: str, ws_b: str):
+    """Kick off both backups, then drain A's events until the injected
+    hard crash severs the socket — proof the process died mid-backup."""
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        async with session.ws_connect(ws_a) as wa, \
+                session.ws_connect(ws_b) as wb:
+            await wa.send_str(json.dumps({"command": "start_backup"}))
+            await wb.send_str(json.dumps({"command": "start_backup"}))
+            while True:
+                msg = await wa.receive()
+                if msg.type != aiohttp.WSMsgType.TEXT:
+                    return
+
+
+async def _backup_then_restore(ws_a: str, src_a: Path):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        async with session.ws_connect(ws_a) as wa:
+            await wa.send_str(json.dumps({"command": "start_backup"}))
+            while True:
+                ev = json.loads(await wa.receive_str())
+                assert ev["kind"] != "error", ev
+                if ev["kind"] == "backup_finished":
+                    break
+            for p in sorted(src_a.rglob("*"), reverse=True):
+                p.unlink() if p.is_file() else p.rmdir()
+            await wa.send_str(json.dumps({"command": "start_restore"}))
+            while True:
+                ev = json.loads(await wa.receive_str())
+                assert ev["kind"] != "error", ev
+                if ev["kind"] == "restore_finished":
+                    return
+
+
+@pytest.mark.slow
+def test_kill9_mid_backup_then_recovery_restores_bytes(tmp_path):
+    """A real client process hard-exits (``os._exit``) at the
+    placement.insert.post seam mid-backup, restarts over the same
+    directories, sweeps, finishes the backup, and restores its corpus
+    byte-for-byte — the whole crash story through the user entry point."""
+    import random
+
+    rng = random.Random(11)
+    src_a, src_b = tmp_path / "a_src", tmp_path / "b_src"
+    files_a = {}
+    for d, tag in ((src_a, "a"), (src_b, "b")):
+        (d / "sub").mkdir(parents=True)
+        data = {"f.bin": rng.randbytes(300_000),
+                "sub/nested.txt": f"hello {tag}\n".encode()}
+        for rel, blob in data.items():
+            (d / rel).write_bytes(blob)
+        if tag == "a":
+            files_a = data
+
+    def client_args(name, src):
+        return ["client", "--non-interactive",
+                "--server-addr", f"127.0.0.1:{port}",
+                "--config-dir", str(tmp_path / name / "cfg"),
+                "--data-dir", str(tmp_path / name / "data"),
+                "--backup-path", str(src),
+                "--ui-bind", "127.0.0.1:0"]
+
+    port = _free_port()
+    server = _spawn(["server", "--bind", f"127.0.0.1:{port}",
+                     "--db", str(tmp_path / "srv.db")])
+    a = b = None
+    try:
+        _wait_line(server, f"listening on 127.0.0.1:{port}")
+        b = _spawn(client_args("b", src_b))
+        ws_b = _ws_url(_wait_line(b, "dashboard at"))
+        # the doomed client: first placement commit hard-exits (code 70)
+        a = _spawn(client_args("a", src_a),
+                   extra_env={"BKW_FAULTS":
+                              "crash=placement.insert.post,crash_hard=1"})
+        ws_a = _ws_url(_wait_line(a, "dashboard at"))
+
+        asyncio.run(asyncio.wait_for(
+            _start_backups_until_crash(ws_a, ws_b), 120))
+        assert a.wait(60) == faults.CRASH_EXIT_CODE
+
+        # restart over the same directories, fault-free
+        a = _spawn(client_args("a", src_a))
+        _wait_line(a, "recovery:")  # the startup sweep announced itself
+        ws_a = _ws_url(_wait_line(a, "dashboard at"))
+        asyncio.run(asyncio.wait_for(
+            _backup_then_restore(ws_a, src_a), 240))
+
+        for rel, blob in files_a.items():
+            assert (src_a / rel).read_bytes() == blob, rel
+    finally:
+        _stop(a)
+        _stop(b)
+        _stop(server)
