@@ -191,6 +191,19 @@ DURABILITY_AUDIT_MAX_AGE_S = 4 * AUDIT_INTERVAL_S
 # day will restart the transfer from its own resume handshake anyway.
 PARTIAL_STORE_TTL_S = 24 * 3600.0
 
+# --- scale-out coordination plane (net/serverstore.py, net/matchmaking.py,
+# docs/server.md; no reference equivalent — the reference is one process
+# over one Postgres) ----------------------------------------------------------
+# In-memory matchmaking shards, keyed by client pubkey.  Each shard has
+# its own lock, FIFO, and deadline heap; fulfill walks shards starting at
+# the requester's home shard (cross-shard work stealing), so the count
+# bounds lock contention, not matchable peers.
+MATCHMAKING_SHARDS = 8
+# Write-behind store: max operations drained into one group commit.  The
+# batch is whatever queued since the last commit, capped here so a
+# firehose cannot defer the commit (and the durability acks) unboundedly.
+SERVER_STORE_MAX_BATCH = 256
+
 # --- server-side TTLs (reference server/src/client_auth_manager.rs:17-20) ---
 AUTH_CHALLENGE_TTL_S = 30.0
 SESSION_TTL_S = 24 * 3600.0

@@ -14,16 +14,28 @@ plane never touches backup data (SURVEY.md §1): it does
 * **snapshot registry** — latest snapshot hash per client plus the peer
   list needed for restore (``db.rs:129-187``, ``handlers/backup.rs``).
 
-Persistent state lives in SQLite (the reference uses Postgres via sqlx;
-an embedded store keeps the framework self-contained — the schema mirrors
-``server/schema/schema.sql``).
+Since PR 10 the process is structured as a **stateless request tier** over
+two swappable planes (docs/server.md):
+
+* persistent state behind :class:`~.serverstore.ServerStore` — by default
+  the write-behind :class:`~.serverstore.SqliteServerStore`, whose commits
+  run on a dedicated writer thread with group commit; handlers ``await
+  store.aio.*`` so a response that promises durability is only written
+  after the commit, and the event loop never blocks on sqlite;
+* matchmaking in :class:`~.matchmaking.ShardedMatchmaker` — N
+  pubkey-sharded in-memory queues with per-shard locks, deadline-heap
+  expiry, and cross-shard work stealing.
+
+``CoordinationServer(legacy=True)`` assembles the pre-PR-10 shape (the
+direct-commit :class:`~.serverstore.ServerDB` plus the single-lock
+:class:`StorageQueue`) as the measured baseline for bench config
+``12_swarm``.
 """
 
 from __future__ import annotations
 
 import asyncio
 import os
-import sqlite3
 import time
 from typing import Dict, Optional
 
@@ -37,13 +49,18 @@ from ..obs import expo as obs_expo
 from ..obs import invariants as obs_invariants
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
+from .matchmaking import (_MATCHMAKINGS, _QUEUE_DEPTH,  # noqa: F401
+                          ShardedMatchmaker)
+from .serverstore import (_MIGRATIONS, _SCHEMA, SCHEMA_VERSION,  # noqa: F401
+                          ServerDB, ServerStore, SqliteServerStore)
 
 _REQUESTS = obs_metrics.counter(
     "bkw_server_requests_total", "Coordination-server requests by route",
     ("path",))
-_QUEUE_DEPTH = obs_metrics.gauge(
-    "bkw_matchmaking_queue_depth",
-    "Storage requests waiting in the matchmaking queue")
+_REQUEST_SECONDS = obs_metrics.histogram(
+    "bkw_server_request_seconds",
+    "Coordination-server request latency by canonical route",
+    ("route",))
 _CONNECTED = obs_metrics.gauge(
     "bkw_server_connected_clients", "Clients on the WS push channel")
 
@@ -55,233 +72,6 @@ obs_metrics.histogram("bkw_transfer_send_seconds",
 obs_metrics.counter("bkw_audit_total", "Audit verdicts by outcome",
                     ("outcome",))
 obs_metrics.counter("bkw_repair_rounds_total", "Peer-loss repair rounds run")
-
-_SCHEMA = """
-CREATE TABLE IF NOT EXISTS clients (
-    pubkey BLOB PRIMARY KEY,
-    registered REAL NOT NULL,
-    last_login REAL
-);
-CREATE TABLE IF NOT EXISTS peer_backups (
-    source BLOB NOT NULL,
-    destination BLOB NOT NULL,
-    size_negotiated INTEGER NOT NULL,
-    timestamp REAL NOT NULL
-);
-CREATE TABLE IF NOT EXISTS snapshots (
-    client_pubkey BLOB NOT NULL,
-    snapshot_hash BLOB NOT NULL,
-    timestamp REAL NOT NULL
-);
-CREATE INDEX IF NOT EXISTS snapshots_by_client
-    ON snapshots (client_pubkey, timestamp);
-CREATE TABLE IF NOT EXISTS audit_reports (
-    reporter BLOB NOT NULL,
-    peer BLOB NOT NULL,
-    passed INTEGER NOT NULL,
-    detail TEXT NOT NULL DEFAULT '',
-    timestamp REAL NOT NULL
-);
-CREATE INDEX IF NOT EXISTS audit_reports_by_peer
-    ON audit_reports (peer, timestamp);
-CREATE TABLE IF NOT EXISTS repair_reports (
-    reporter BLOB NOT NULL,
-    peer BLOB NOT NULL,
-    packfiles_lost INTEGER NOT NULL,
-    bytes_lost INTEGER NOT NULL,
-    bytes_replaced INTEGER NOT NULL,
-    timestamp REAL NOT NULL
-);
-CREATE TABLE IF NOT EXISTS metadata (
-    key TEXT PRIMARY KEY,
-    value TEXT NOT NULL
-);
-"""
-
-#: Bump when the schema changes shape; pre-versioning databases (PR 1 and
-#: earlier, which had no ``metadata`` table) count as version 1.
-SCHEMA_VERSION = 2
-
-#: THE migration seam: ``{from_version: [SQL statements]}`` applied in
-#: sequence by :meth:`ServerDB._migrate` to reach ``from_version + 1``.
-#: Statements must be idempotent (IF NOT EXISTS / OR IGNORE) because a
-#: crash between a migration and the version stamp replays it on the next
-#: boot.  A Postgres twin of ServerDB would run the same ladder.
-_MIGRATIONS = {
-    # v1 (PR 1) -> v2: repair_reports + the metadata table itself.  Both
-    # already appear in _SCHEMA's CREATE IF NOT EXISTS, so this rung is
-    # empty — it exists to document the pattern for the next real change.
-    1: [],
-}
-
-
-class ServerDB:
-    """server/src/db.rs equivalent (embedded SQLite).
-
-    The reference runs the coordination schema on Postgres
-    (``server/src/db.rs:12-40``); here it is embedded.  Concurrency
-    envelope, documented deliberately: WAL mode gives concurrent readers
-    with a single writer, and every write the coordination plane makes
-    (client registration, storage-request rows, negotiation records) is a
-    sub-millisecond single-row statement at human backup cadence — orders
-    of magnitude under SQLite's write ceiling.  The seam for a
-    server-farm deployment is this class: it is the only component that
-    touches the database, so a Postgres-backed twin can replace it
-    without touching handlers.
-    """
-
-    def __init__(self, path):
-        self._db = sqlite3.connect(path, check_same_thread=False)
-        if path != ":memory:":
-            self._db.execute("PRAGMA journal_mode=WAL")
-        self._db.execute("PRAGMA synchronous=NORMAL")
-        self._db.executescript(_SCHEMA)
-        self._db.commit()
-        self._migrate()
-
-    def _migrate(self) -> None:
-        """Boot-time schema version check (VERDICT r5 missing #3).
-
-        * fresh or pre-versioning database -> run the ladder from v1 and
-          stamp :data:`SCHEMA_VERSION` (the _SCHEMA script is idempotent,
-          so replaying it on a v1 database upgrades it in place);
-        * versioned database older than the code -> apply each rung of
-          :data:`_MIGRATIONS` in order, stamping after each one;
-        * database NEWER than the code -> refuse to start: old code
-          writing rows a newer schema reinterprets is silent corruption.
-        """
-        row = self._db.execute(
-            "SELECT value FROM metadata WHERE key = 'schema_version'"
-        ).fetchone()
-        version = int(row[0]) if row is not None else 1
-        if version > SCHEMA_VERSION:
-            raise RuntimeError(
-                f"database schema v{version} is newer than this server"
-                f" (v{SCHEMA_VERSION}); upgrade the server binary")
-        while version < SCHEMA_VERSION:
-            for stmt in _MIGRATIONS.get(version, ()):
-                self._db.execute(stmt)
-            version += 1
-            self._db.execute(
-                "INSERT INTO metadata (key, value) VALUES"
-                " ('schema_version', ?) ON CONFLICT(key)"
-                " DO UPDATE SET value = excluded.value", (str(version),))
-            self._db.commit()
-        if row is None:
-            self._db.execute(
-                "INSERT OR IGNORE INTO metadata (key, value) VALUES"
-                " ('schema_version', ?)", (str(SCHEMA_VERSION),))
-            self._db.commit()
-
-    def schema_version(self) -> int:
-        row = self._db.execute(
-            "SELECT value FROM metadata WHERE key = 'schema_version'"
-        ).fetchone()
-        return int(row[0])
-
-    def register_client(self, pubkey: bytes) -> None:
-        self._db.execute(
-            "INSERT OR IGNORE INTO clients (pubkey, registered) VALUES (?, ?)",
-            (pubkey, time.time()))
-        self._db.commit()
-
-    def client_exists(self, pubkey: bytes) -> bool:
-        return self._db.execute("SELECT 1 FROM clients WHERE pubkey = ?",
-                                (pubkey,)).fetchone() is not None
-
-    def client_update_logged_in(self, pubkey: bytes) -> None:
-        self._db.execute("UPDATE clients SET last_login = ? WHERE pubkey = ?",
-                         (time.time(), pubkey))
-        self._db.commit()
-
-    def save_storage_negotiated(self, source: bytes, destination: bytes,
-                                size: int) -> None:
-        self._db.execute(
-            "INSERT INTO peer_backups (source, destination, size_negotiated,"
-            " timestamp) VALUES (?, ?, ?, ?)",
-            (source, destination, size, time.time()))
-        self._db.commit()
-
-    def delete_storage_negotiated(self, source: bytes, destination: bytes,
-                                  size: int) -> None:
-        """Roll back one just-recorded negotiation (failed-push compensation
-        in StorageQueue.fulfill)."""
-        self._db.execute(
-            "DELETE FROM peer_backups WHERE rowid = ("
-            " SELECT rowid FROM peer_backups WHERE source = ?"
-            " AND destination = ? AND size_negotiated = ?"
-            " ORDER BY timestamp DESC LIMIT 1)",
-            (source, destination, size))
-        self._db.commit()
-
-    def save_snapshot(self, pubkey: bytes, snapshot_hash: bytes) -> None:
-        self._db.execute(
-            "INSERT INTO snapshots (client_pubkey, snapshot_hash, timestamp)"
-            " VALUES (?, ?, ?)", (pubkey, snapshot_hash, time.time()))
-        self._db.commit()
-
-    def get_latest_client_snapshot(self, pubkey: bytes) -> Optional[bytes]:
-        row = self._db.execute(
-            "SELECT snapshot_hash FROM snapshots WHERE client_pubkey = ?"
-            " ORDER BY timestamp DESC LIMIT 1", (pubkey,)).fetchone()
-        return None if row is None else bytes(row[0])
-
-    def get_client_negotiated_peers(self, pubkey: bytes) -> list:
-        rows = self._db.execute(
-            "SELECT DISTINCT destination FROM peer_backups WHERE source = ?",
-            (pubkey,)).fetchall()
-        return [bytes(r[0]) for r in rows]
-
-    def get_clients_storing_on(self, pubkey: bytes) -> list:
-        """Sources with data on ``pubkey`` (the reverse negotiation edge)."""
-        rows = self._db.execute(
-            "SELECT DISTINCT source FROM peer_backups WHERE destination = ?",
-            (pubkey,)).fetchall()
-        return [bytes(r[0]) for r in rows]
-
-    def save_audit_report(self, reporter: bytes, peer: bytes, passed: bool,
-                          detail: str) -> None:
-        self._db.execute(
-            "INSERT INTO audit_reports (reporter, peer, passed, detail,"
-            " timestamp) VALUES (?, ?, ?, ?, ?)",
-            (reporter, peer, int(passed), detail, time.time()))
-        self._db.commit()
-
-    def save_repair_report(self, reporter: bytes, peer: bytes,
-                           packfiles_lost: int, bytes_lost: int,
-                           bytes_replaced: int) -> None:
-        self._db.execute(
-            "INSERT INTO repair_reports (reporter, peer, packfiles_lost,"
-            " bytes_lost, bytes_replaced, timestamp) VALUES (?, ?, ?, ?, ?, ?)",
-            (reporter, peer, int(packfiles_lost), int(bytes_lost),
-             int(bytes_replaced), time.time()))
-        self._db.commit()
-
-    def reclaim_negotiation(self, client: bytes, peer: bytes) -> int:
-        """Retire every negotiation edge between ``client`` and a lost
-        ``peer`` (both directions): the allowance is unusable, and restore
-        peer lists must stop naming the dead peer.  Returns rows removed."""
-        cur = self._db.execute(
-            "DELETE FROM peer_backups WHERE (source = ? AND destination = ?)"
-            " OR (source = ? AND destination = ?)",
-            (client, peer, peer, client))
-        self._db.commit()
-        return cur.rowcount
-
-    def audit_failing_reporters(self, peer: bytes,
-                                window_s: float) -> int:
-        """Distinct reporters whose LATEST report on ``peer`` within the
-        window is a failure.  A later pass from the same reporter clears
-        its vote, so a recovered peer re-enters matchmaking without any
-        server-side state surgery."""
-        rows = self._db.execute(
-            "SELECT reporter, passed FROM audit_reports"
-            " WHERE peer = ? AND timestamp >= ? ORDER BY timestamp",
-            (peer, time.time() - window_s)).fetchall()
-        latest: Dict[bytes, int] = {}
-        for reporter, passed in rows:
-            latest[bytes(reporter)] = passed
-        return sum(1 for passed in latest.values() if not passed)
 
 
 class AuthManager:
@@ -356,14 +146,23 @@ class Connections:
 
 
 class StorageQueue:
-    """The matchmaking economy (backup_request.rs): an expiring queue of
-    (client, bytes-wanted) fulfilled by pairing clients with each other."""
+    """The original single-lock matchmaking economy (backup_request.rs):
+    an expiring list of (client, bytes-wanted) fulfilled by pairing
+    clients with each other.
 
-    def __init__(self, db: ServerDB, connections: Connections,
-                 expiry_s: float = defaults.BACKUP_REQUEST_EXPIRY_S):
+    Retained as the measured baseline for the sharded matchmaker
+    (``CoordinationServer(legacy=True)``, bench config ``12_swarm``) and
+    because its semantics tests pin the matchmaking contract both
+    implementations honor.  Structural costs, by design: ``_lock`` is
+    held across the WHOLE fulfill — db writes and WS pushes included —
+    and expiry rescans the list front on every pop."""
+
+    def __init__(self, db, connections: Connections,
+                 expiry_s: float = None):
         self.db = db
         self.connections = connections
-        self.expiry_s = expiry_s
+        self.expiry_s = (defaults.BACKUP_REQUEST_EXPIRY_S
+                         if expiry_s is None else expiry_s)
         self._queue: list = []  # (client_id, remaining, expires_at)
         self._lock = asyncio.Lock()
 
@@ -448,6 +247,7 @@ class StorageQueue:
                     self.db.delete_storage_negotiated(
                         candidate, bytes(client_id), match)
                     continue
+                _MATCHMAKINGS.inc()
                 ok_self = await self.connections.notify(
                     bytes(client_id), wire.BackupMatched(
                         destination_id=candidate, storage_available=match))
@@ -480,9 +280,12 @@ class StorageQueue:
 
 @web.middleware
 async def _obs_middleware(request, handler):
-    """Per-request observability: count by canonical route (bounded label
-    cardinality) and adopt the client's trace id from the POST JSON so
-    the server-side span journals under the same id as the caller's."""
+    """Per-request observability: count and time by canonical route
+    (bounded label cardinality — the route table, not raw paths) and
+    adopt the client's trace id from the POST JSON so the server-side
+    span journals under the same id as the caller's.  The latency lands
+    in ``bkw_server_request_seconds{route}``; the swarm scorecard and
+    bench config 12 read their p99 from its buckets."""
     resource = request.match_info.route.resource
     path = resource.canonical if resource is not None else request.path
     _REQUESTS.inc(path=path)
@@ -493,16 +296,46 @@ async def _obs_middleware(request, handler):
             trace_id = json.loads(await request.text()).get("trace_id")
         except (ValueError, UnicodeDecodeError):
             pass
-    with obs_trace.bind(trace_id), obs_trace.span(f"server{path}"):
-        return await handler(request)
+    t0 = time.monotonic()
+    try:
+        with obs_trace.bind(trace_id), obs_trace.span(f"server{path}"):
+            return await handler(request)
+    finally:
+        _REQUEST_SECONDS.observe(time.monotonic() - t0, route=path)
 
 
 class CoordinationServer:
-    def __init__(self, db_path=":memory:"):
-        self.db = ServerDB(db_path)
+    """The stateless request tier.
+
+    Handlers keep no cross-request state beyond the auth/session maps
+    and the live WS registry; persistent state is behind ``self.db`` (a
+    :class:`~.serverstore.ServerStore`) and queueing behind
+    ``self.queue``.  Durable writes go through ``self.db.aio`` — in the
+    default write-behind store the await resolves only after the group
+    commit, so the durability-promising responses (registration, login
+    bookkeeping, snapshot registration, audit/repair verdicts,
+    negotiation records) are acknowledged only once committed, without
+    ever running a sqlite commit on the event loop.
+
+    ``legacy=True`` assembles the pre-PR-10 single-lock shape over a
+    direct-commit store — the bench baseline.  ``store=`` injects any
+    other :class:`~.serverstore.ServerStore` implementation.
+    """
+
+    def __init__(self, db_path=":memory:", store: Optional[ServerStore] = None,
+                 legacy: bool = False, shards: Optional[int] = None):
+        if store is None:
+            store = (ServerDB(db_path) if legacy
+                     else SqliteServerStore(db_path))
+        self.db = store
+        self.legacy = bool(legacy)
         self.auth = AuthManager()
         self.connections = Connections()
-        self.queue = StorageQueue(self.db, self.connections)
+        if legacy:
+            self.queue = StorageQueue(self.db, self.connections)
+        else:
+            self.queue = ShardedMatchmaker(self.db, self.connections,
+                                           shards=shards)
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
         self._started = time.time()
@@ -561,17 +394,17 @@ class CoordinationServer:
             raise self._err(wire.ErrorKind.RETRY)
         if not verify_signature(msg.pubkey, nonce, msg.challenge_response):
             raise self._err(wire.ErrorKind.BAD_REQUEST, "bad signature")
-        if self.db.client_exists(msg.pubkey):
+        if await self.db.aio.client_exists(msg.pubkey):
             # 409 CONFLICT with a BadRequest payload (ClientExists,
             # handlers/mod.rs:66,79)
             raise self._err(wire.ErrorKind.BAD_REQUEST,
                             "client already exists", status=409)
-        self.db.register_client(msg.pubkey)
+        await self.db.aio.register_client(msg.pubkey)
         return self._ok()
 
     async def login_begin(self, request):
         msg = await self._parse(request, wire.ClientLoginRequest)
-        if not self.db.client_exists(msg.pubkey):
+        if not await self.db.aio.client_exists(msg.pubkey):
             raise self._err(wire.ErrorKind.CLIENT_NOT_FOUND)
         return self._ok(wire.ServerChallenge(
             nonce=self.auth.challenge_begin(msg.pubkey)))
@@ -583,7 +416,7 @@ class CoordinationServer:
             raise self._err(wire.ErrorKind.RETRY)
         if not verify_signature(msg.pubkey, nonce, msg.challenge_response):
             raise self._err(wire.ErrorKind.BAD_REQUEST, "bad signature")
-        self.db.client_update_logged_in(msg.pubkey)
+        await self.db.aio.client_update_logged_in(msg.pubkey)
         return self._ok(wire.LoginToken(token=self.auth.session_start(msg.pubkey)))
 
     async def backup_request(self, request):
@@ -599,17 +432,17 @@ class CoordinationServer:
     async def backup_done(self, request):
         msg = await self._parse(request, wire.BackupDone)
         client = self._session(msg)
-        self.db.save_snapshot(client, msg.snapshot_hash)
+        await self.db.aio.save_snapshot(client, msg.snapshot_hash)
         return self._ok()
 
     async def backup_restore(self, request):
         msg = await self._parse(request, wire.BackupRestoreRequest)
         client = self._session(msg)
-        snapshot = self.db.get_latest_client_snapshot(client)
+        snapshot = await self.db.aio.get_latest_client_snapshot(client)
         if snapshot is None:
             # NoBackupsAvailable -> 404 NoBackups (handlers/backup.rs:30-38)
             raise self._err(wire.ErrorKind.NO_BACKUPS)
-        peers = self.db.get_client_negotiated_peers(client)
+        peers = await self.db.aio.get_client_negotiated_peers(client)
         # advertise the deployment's stripe geometry so a from-scratch
         # restore client knows how many peer streams can go dark before
         # coverage is actually at risk (the shard containers themselves
@@ -646,10 +479,10 @@ class CoordinationServer:
         msg = await self._parse(request, wire.AuditReport)
         client = self._session(msg)
         peer = bytes(msg.peer_id)
-        self.db.save_audit_report(client, peer, bool(msg.passed),
-                                  msg.detail or "")
+        await self.db.aio.save_audit_report(client, peer, bool(msg.passed),
+                                            msg.detail or "")
         if not msg.passed:
-            for source in self.db.get_clients_storing_on(peer):
+            for source in await self.db.aio.get_clients_storing_on(peer):
                 if source not in (client, peer):
                     await self.connections.notify(
                         source, wire.AuditDue(peer_id=peer))
@@ -667,9 +500,10 @@ class CoordinationServer:
         if peer == client:
             raise self._err(wire.ErrorKind.BAD_REQUEST,
                             "cannot repair away from self")
-        self.db.save_repair_report(client, peer, msg.packfiles_lost,
-                                   msg.bytes_lost, msg.bytes_replaced)
-        self.db.reclaim_negotiation(client, peer)
+        await self.db.aio.save_repair_report(client, peer, msg.packfiles_lost,
+                                             msg.bytes_lost,
+                                             msg.bytes_replaced)
+        await self.db.aio.reclaim_negotiation(client, peer)
         return self._ok()
 
     # --- observability exposition (obs/expo.py) -----------------------------
@@ -689,7 +523,7 @@ class CoordinationServer:
         the whole document 503 (obs/expo.py)."""
         durability = obs_invariants.summary_from_registry()
         return obs_expo.health_response(
-            schema_version=self.db.schema_version(),
+            schema_version=await self.db.aio.schema_version(),
             queue_depth=self.queue.pending(),
             connected_clients=self.connections.count(),
             uptime_s=round(time.time() - self._started, 3),
@@ -755,3 +589,6 @@ class CoordinationServer:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+        # drain + retire the writer thread; the store stays readable
+        # (tests inspect server.db after stop)
+        self.db.close()

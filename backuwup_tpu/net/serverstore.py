@@ -1,0 +1,588 @@
+"""Swappable server storage backend (the PR-10 scale-out seam).
+
+The coordination plane's persistent state is small and *naturally
+shard-keyed*: every row the server writes — client identity, negotiation
+edges, snapshots, audit verdicts, repair reports — is keyed by a client
+pubkey (or a pubkey pair).  :class:`ServerStore` pins that contract down
+as an abstract interface so the request tier in ``net/server.py`` stays
+stateless: a Postgres/Vitess-style horizontally sharded twin can slot in
+behind the same method set, routing each call by its leading pubkey
+argument, without the handlers changing.
+
+:class:`SqliteServerStore` is the embedded implementation, in two modes:
+
+* **write-behind (default)** — a single writer thread owns the sqlite
+  connection; every operation (reads included, which buys read-your-
+  writes ordering for free) is submitted to an op queue and executed on
+  that thread.  The writer drains whatever has queued since the last
+  batch and commits ONCE per drain — group commit: under load, hundreds
+  of single-row writes amortize one ``COMMIT`` (and one fsync when
+  fsync discipline is on).  Callers get a future that resolves only
+  *after* the commit, so an ``await store.aio.save_snapshot(...)`` in a
+  handler is a durability barrier: the response cannot be written until
+  the row is committed, yet the event loop never blocks — the commit
+  happens on the writer thread (asserted by the swarm test's event-loop
+  stall detector and by :attr:`commit_threads`).
+* **direct** (``write_behind=False``, the :class:`ServerDB` shim) — the
+  pre-PR-10 shape: every call executes inline on the calling thread and
+  commits immediately.  Kept as the measured baseline for bench config
+  ``12_swarm`` and for tests that predate the writer thread.  Unlike
+  the original, calls are serialized under an RLock: the original
+  shared one ``check_same_thread=False`` connection across threads with
+  no serialization at all (the latent bug this PR's regression test
+  hammers).
+
+Fsync discipline follows ``utils/durable.py`` semantics: when
+``durable.FSYNC_ENABLED`` (the ``BKW_FSYNC`` switch) a file-backed
+database runs ``PRAGMA synchronous=FULL`` so a group commit is a real
+durability barrier; with fsync disabled it drops to ``NORMAL`` (the
+pure-tmpfs test posture).  Both store modes apply the same pragma so the
+bench's baseline-vs-sharded comparison is durability-for-durability.
+"""
+
+from __future__ import annotations
+
+import abc
+import queue
+import sqlite3
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+from .. import defaults
+from ..obs import metrics as obs_metrics
+from ..utils import durable
+
+_COMMITS = obs_metrics.counter(
+    "bkw_server_store_commits_total",
+    "Server-store sqlite commits by mode (group = write-behind batch)",
+    ("mode",))
+_BATCH_OPS = obs_metrics.histogram(
+    "bkw_server_store_batch_ops",
+    "Operations drained per write-behind group commit",
+    buckets=obs_metrics.log_buckets(1.0, 2.0, 11))
+_OP_QUEUE_DEPTH = obs_metrics.gauge(
+    "bkw_server_store_queue_depth",
+    "Write-behind operations waiting for the writer thread")
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS clients (
+    pubkey BLOB PRIMARY KEY,
+    registered REAL NOT NULL,
+    last_login REAL
+);
+CREATE TABLE IF NOT EXISTS peer_backups (
+    source BLOB NOT NULL,
+    destination BLOB NOT NULL,
+    size_negotiated INTEGER NOT NULL,
+    timestamp REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS snapshots (
+    client_pubkey BLOB NOT NULL,
+    snapshot_hash BLOB NOT NULL,
+    timestamp REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS snapshots_by_client
+    ON snapshots (client_pubkey, timestamp);
+CREATE TABLE IF NOT EXISTS audit_reports (
+    reporter BLOB NOT NULL,
+    peer BLOB NOT NULL,
+    passed INTEGER NOT NULL,
+    detail TEXT NOT NULL DEFAULT '',
+    timestamp REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS audit_reports_by_peer
+    ON audit_reports (peer, timestamp);
+CREATE TABLE IF NOT EXISTS repair_reports (
+    reporter BLOB NOT NULL,
+    peer BLOB NOT NULL,
+    packfiles_lost INTEGER NOT NULL,
+    bytes_lost INTEGER NOT NULL,
+    bytes_replaced INTEGER NOT NULL,
+    timestamp REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS metadata (
+    key TEXT PRIMARY KEY,
+    value TEXT NOT NULL
+);
+"""
+
+#: Bump when the schema changes shape; pre-versioning databases (PR 1 and
+#: earlier, which had no ``metadata`` table) count as version 1.
+SCHEMA_VERSION = 2
+
+#: THE migration seam: ``{from_version: [SQL statements]}`` applied in
+#: sequence by the boot-time migrate to reach ``from_version + 1``.
+#: Statements must be idempotent (IF NOT EXISTS / OR IGNORE) because a
+#: crash between a migration and the version stamp replays it on the next
+#: boot.  A Postgres twin of SqliteServerStore would run the same ladder.
+_MIGRATIONS = {
+    # v1 (PR 1) -> v2: repair_reports + the metadata table itself.  Both
+    # already appear in _SCHEMA's CREATE IF NOT EXISTS, so this rung is
+    # empty — it exists to document the pattern for the next real change.
+    1: [],
+}
+
+
+class ServerStore(abc.ABC):
+    """Abstract coordination-plane store, keyed by client pubkey.
+
+    Every method's FIRST pubkey argument is its shard key; a distributed
+    implementation routes on it.  ``peer_backups`` rows are dual-homed
+    (one copy under each endpoint's shard) in such a deployment — the
+    sqlite implementation keeps one table and both query directions.
+
+    Implementations must expose:
+
+    * the synchronous method set below (tests and setup scripts call
+      them directly; they may block briefly),
+    * :attr:`aio` — the same methods as awaitables that never block the
+      event loop AND, for writes, resolve only once the write is
+      durable (the request tier's durability barrier),
+    * :meth:`flush` / :meth:`close` lifecycle hooks.
+    """
+
+    @abc.abstractmethod
+    def register_client(self, pubkey: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def client_exists(self, pubkey: bytes) -> bool: ...
+
+    @abc.abstractmethod
+    def client_update_logged_in(self, pubkey: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def save_storage_negotiated(self, source: bytes, destination: bytes,
+                                size: int) -> None: ...
+
+    @abc.abstractmethod
+    def delete_storage_negotiated(self, source: bytes, destination: bytes,
+                                  size: int) -> None: ...
+
+    @abc.abstractmethod
+    def save_snapshot(self, pubkey: bytes, snapshot_hash: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def get_latest_client_snapshot(self,
+                                   pubkey: bytes) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    def get_client_negotiated_peers(self, pubkey: bytes) -> list: ...
+
+    @abc.abstractmethod
+    def get_clients_storing_on(self, pubkey: bytes) -> list: ...
+
+    @abc.abstractmethod
+    def save_audit_report(self, reporter: bytes, peer: bytes, passed: bool,
+                          detail: str) -> None: ...
+
+    @abc.abstractmethod
+    def save_repair_report(self, reporter: bytes, peer: bytes,
+                           packfiles_lost: int, bytes_lost: int,
+                           bytes_replaced: int) -> None: ...
+
+    @abc.abstractmethod
+    def reclaim_negotiation(self, client: bytes, peer: bytes) -> int: ...
+
+    @abc.abstractmethod
+    def audit_failing_reporters(self, peer: bytes,
+                                window_s: float) -> int: ...
+
+    @abc.abstractmethod
+    def schema_version(self) -> int: ...
+
+    def flush(self) -> None:
+        """Barrier: every previously submitted write is durable on
+        return."""
+
+    def close(self) -> None:
+        """Stop background machinery; the store stays usable for
+        post-shutdown reads (tests inspect state after server.stop())."""
+
+
+class _AioFacade:
+    """``store.aio.<method>(...)`` — the handler-facing async view.
+
+    Write-behind: wraps the op's :class:`~concurrent.futures.Future` so
+    the coroutine resumes only after the writer thread's group commit.
+    Direct mode: runs the sync method inline on the event loop —
+    deliberately preserving the pre-PR-10 blocking-commit behavior for
+    the bench baseline.
+    """
+
+    def __init__(self, store: "SqliteServerStore"):
+        self._store = store
+
+    def __getattr__(self, name: str):
+        op = getattr(type(self._store), "_op_" + name, None)
+        if op is None:
+            raise AttributeError(name)
+        store = self._store
+
+        async def call(*args):
+            if not store.write_behind:
+                return getattr(store, name)(*args)
+            import asyncio
+            return await asyncio.wrap_future(store._submit(op, args))
+
+        call.__name__ = name
+        return call
+
+
+class SqliteServerStore(ServerStore):
+    """Embedded sqlite ServerStore; see the module docstring for the
+    write-behind/direct split."""
+
+    def __init__(self, path, write_behind: bool = True):
+        self.path = path
+        self.write_behind = bool(write_behind)
+        #: thread idents observed executing COMMIT for request-path ops
+        #: (NOT the constructor's schema bootstrap) — the swarm test
+        #: asserts the event-loop thread never appears here.
+        self.commit_threads: set = set()
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        if path != ":memory:":
+            self._db.execute("PRAGMA journal_mode=WAL")
+            # fsync-disciplined group commit (utils/durable.py semantics):
+            # FULL makes each COMMIT a durability barrier; with fsync
+            # globally off (BKW_FSYNC=0 test runs) NORMAL suffices.
+            self._db.execute("PRAGMA synchronous=%s"
+                             % ("FULL" if durable.FSYNC_ENABLED
+                                else "NORMAL"))
+        self._db.executescript(_SCHEMA)
+        self._db.commit()
+        self._migrate()  # raises synchronously on a newer-schema database
+        self._direct_lock = threading.RLock()
+        self._submit_lock = threading.Lock()
+        self._closed = False
+        self._ops: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._depth = 0
+        self._writer: Optional[threading.Thread] = None
+        if self.write_behind:
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="serverstore-writer",
+                daemon=True)
+            self._writer.start()
+
+    # --- write-behind machinery --------------------------------------------
+
+    def _submit(self, op, args) -> Future:
+        fut: Future = Future()
+        with self._submit_lock:
+            if self._closed or not self.write_behind:
+                # post-close (or direct-mode) fallback: run inline,
+                # serialized, committed immediately
+                try:
+                    with self._direct_lock:
+                        result = op(self._db, *args)
+                        self._commit("direct")
+                    fut.set_result(result)
+                except BaseException as e:
+                    fut.set_exception(e)
+                return fut
+            self._ops.put((op, args, fut))
+            self._depth += 1
+            _OP_QUEUE_DEPTH.set(self._depth)
+        return fut
+
+    def _writer_loop(self) -> None:
+        while True:
+            head = self._ops.get()
+            if head is None:
+                return
+            batch = [head]
+            # group commit: drain everything already queued (bounded so a
+            # firehose cannot starve the commit), execute, commit ONCE,
+            # then resolve every future — durability before acknowledgment
+            while len(batch) < defaults.SERVER_STORE_MAX_BATCH:
+                try:
+                    nxt = self._ops.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._ops.put(None)  # re-arm shutdown for next round
+                    break
+                batch.append(nxt)
+            with self._submit_lock:
+                self._depth -= len(batch)
+                _OP_QUEUE_DEPTH.set(max(self._depth, 0))
+            results = []
+            for op, args, _fut in batch:
+                try:
+                    results.append((True, op(self._db, *args)))
+                except BaseException as e:  # per-op isolation
+                    results.append((False, e))
+            self._commit("group")
+            _BATCH_OPS.observe(float(len(batch)))
+            for (ok, value), (_op, _args, fut) in zip(results, batch):
+                if ok:
+                    fut.set_result(value)
+                else:
+                    fut.set_exception(value)
+
+    def _commit(self, mode: str) -> None:
+        if self._db.in_transaction:
+            self._db.commit()
+            _COMMITS.inc(mode=mode)
+            self.commit_threads.add(threading.get_ident())
+
+    def flush(self) -> None:
+        if self.write_behind and not self._closed:
+            self._submit(lambda _conn: None, ()).result()
+
+    def close(self) -> None:
+        """Drain the op queue, stop the writer thread, and flip to the
+        inline fallback (the connection stays open so post-shutdown test
+        reads keep working)."""
+        with self._submit_lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._writer is not None:
+            self._ops.put(None)
+            self._writer.join(timeout=10)
+            self._writer = None
+
+    # --- sync + async facades ----------------------------------------------
+
+    @property
+    def aio(self) -> _AioFacade:
+        return _AioFacade(self)
+
+    def _run(self, op, *args):
+        if self.write_behind:
+            return self._submit(op, args).result()
+        with self._direct_lock:
+            result = op(self._db, *args)
+            self._commit("direct")
+            return result
+
+    # --- schema ------------------------------------------------------------
+
+    def _migrate(self) -> None:
+        """Boot-time schema version check (runs on the constructing
+        thread, before the writer starts, so version errors raise
+        synchronously).
+
+        * fresh or pre-versioning database -> run the ladder from v1 and
+          stamp :data:`SCHEMA_VERSION` (the _SCHEMA script is idempotent,
+          so replaying it on a v1 database upgrades it in place);
+        * versioned database older than the code -> apply each rung of
+          :data:`_MIGRATIONS` in order, stamping after each one;
+        * database NEWER than the code -> refuse to start: old code
+          writing rows a newer schema reinterprets is silent corruption.
+        """
+        row = self._db.execute(
+            "SELECT value FROM metadata WHERE key = 'schema_version'"
+        ).fetchone()
+        version = int(row[0]) if row is not None else 1
+        if version > SCHEMA_VERSION:
+            raise RuntimeError(
+                f"database schema v{version} is newer than this server"
+                f" (v{SCHEMA_VERSION}); upgrade the server binary")
+        while version < SCHEMA_VERSION:
+            for stmt in _MIGRATIONS.get(version, ()):
+                self._db.execute(stmt)
+            version += 1
+            self._db.execute(
+                "INSERT INTO metadata (key, value) VALUES"
+                " ('schema_version', ?) ON CONFLICT(key)"
+                " DO UPDATE SET value = excluded.value", (str(version),))
+            self._db.commit()
+        if row is None:
+            self._db.execute(
+                "INSERT OR IGNORE INTO metadata (key, value) VALUES"
+                " ('schema_version', ?)", (str(SCHEMA_VERSION),))
+            self._db.commit()
+
+    # --- operations (each = one statement batch on the writer's conn) ------
+    # The _op_* staticmethods are the single source of truth: the sync
+    # facade and store.aio both execute exactly these against the one
+    # connection, so ordering and read-your-writes hold in every mode.
+
+    @staticmethod
+    def _op_schema_version(conn) -> int:
+        row = conn.execute(
+            "SELECT value FROM metadata WHERE key = 'schema_version'"
+        ).fetchone()
+        return int(row[0])
+
+    @staticmethod
+    def _op_register_client(conn, pubkey: bytes) -> None:
+        conn.execute(
+            "INSERT OR IGNORE INTO clients (pubkey, registered) VALUES (?, ?)",
+            (pubkey, time.time()))
+
+    @staticmethod
+    def _op_client_exists(conn, pubkey: bytes) -> bool:
+        return conn.execute("SELECT 1 FROM clients WHERE pubkey = ?",
+                            (pubkey,)).fetchone() is not None
+
+    @staticmethod
+    def _op_client_update_logged_in(conn, pubkey: bytes) -> None:
+        conn.execute("UPDATE clients SET last_login = ? WHERE pubkey = ?",
+                     (time.time(), pubkey))
+
+    @staticmethod
+    def _op_save_storage_negotiated(conn, source: bytes, destination: bytes,
+                                    size: int) -> None:
+        conn.execute(
+            "INSERT INTO peer_backups (source, destination, size_negotiated,"
+            " timestamp) VALUES (?, ?, ?, ?)",
+            (source, destination, size, time.time()))
+
+    @staticmethod
+    def _op_delete_storage_negotiated(conn, source: bytes,
+                                      destination: bytes, size: int) -> None:
+        conn.execute(
+            "DELETE FROM peer_backups WHERE rowid = ("
+            " SELECT rowid FROM peer_backups WHERE source = ?"
+            " AND destination = ? AND size_negotiated = ?"
+            " ORDER BY timestamp DESC LIMIT 1)",
+            (source, destination, size))
+
+    @staticmethod
+    def _op_save_snapshot(conn, pubkey: bytes, snapshot_hash: bytes) -> None:
+        conn.execute(
+            "INSERT INTO snapshots (client_pubkey, snapshot_hash, timestamp)"
+            " VALUES (?, ?, ?)", (pubkey, snapshot_hash, time.time()))
+
+    @staticmethod
+    def _op_get_latest_client_snapshot(conn,
+                                       pubkey: bytes) -> Optional[bytes]:
+        row = conn.execute(
+            "SELECT snapshot_hash FROM snapshots WHERE client_pubkey = ?"
+            " ORDER BY timestamp DESC LIMIT 1", (pubkey,)).fetchone()
+        return None if row is None else bytes(row[0])
+
+    @staticmethod
+    def _op_get_client_negotiated_peers(conn, pubkey: bytes) -> list:
+        rows = conn.execute(
+            "SELECT DISTINCT destination FROM peer_backups WHERE source = ?",
+            (pubkey,)).fetchall()
+        return [bytes(r[0]) for r in rows]
+
+    @staticmethod
+    def _op_get_clients_storing_on(conn, pubkey: bytes) -> list:
+        rows = conn.execute(
+            "SELECT DISTINCT source FROM peer_backups WHERE destination = ?",
+            (pubkey,)).fetchall()
+        return [bytes(r[0]) for r in rows]
+
+    @staticmethod
+    def _op_save_audit_report(conn, reporter: bytes, peer: bytes,
+                              passed: bool, detail: str) -> None:
+        conn.execute(
+            "INSERT INTO audit_reports (reporter, peer, passed, detail,"
+            " timestamp) VALUES (?, ?, ?, ?, ?)",
+            (reporter, peer, int(passed), detail, time.time()))
+
+    @staticmethod
+    def _op_save_repair_report(conn, reporter: bytes, peer: bytes,
+                               packfiles_lost: int, bytes_lost: int,
+                               bytes_replaced: int) -> None:
+        conn.execute(
+            "INSERT INTO repair_reports (reporter, peer, packfiles_lost,"
+            " bytes_lost, bytes_replaced, timestamp) VALUES (?, ?, ?, ?, ?, ?)",
+            (reporter, peer, int(packfiles_lost), int(bytes_lost),
+             int(bytes_replaced), time.time()))
+
+    @staticmethod
+    def _op_reclaim_negotiation(conn, client: bytes, peer: bytes) -> int:
+        cur = conn.execute(
+            "DELETE FROM peer_backups WHERE (source = ? AND destination = ?)"
+            " OR (source = ? AND destination = ?)",
+            (client, peer, peer, client))
+        return cur.rowcount
+
+    @staticmethod
+    def _op_audit_failing_reporters(conn, peer: bytes,
+                                    window_s: float) -> int:
+        rows = conn.execute(
+            "SELECT reporter, passed FROM audit_reports"
+            " WHERE peer = ? AND timestamp >= ? ORDER BY timestamp",
+            (peer, time.time() - window_s)).fetchall()
+        latest: Dict[bytes, int] = {}
+        for reporter, passed in rows:
+            latest[bytes(reporter)] = passed
+        return sum(1 for passed in latest.values() if not passed)
+
+    # --- the ServerDB-compatible sync surface -------------------------------
+
+    def schema_version(self) -> int:
+        return self._run(self._op_schema_version)
+
+    def register_client(self, pubkey: bytes) -> None:
+        self._run(self._op_register_client, pubkey)
+
+    def client_exists(self, pubkey: bytes) -> bool:
+        return self._run(self._op_client_exists, pubkey)
+
+    def client_update_logged_in(self, pubkey: bytes) -> None:
+        self._run(self._op_client_update_logged_in, pubkey)
+
+    def save_storage_negotiated(self, source: bytes, destination: bytes,
+                                size: int) -> None:
+        self._run(self._op_save_storage_negotiated, source, destination,
+                  size)
+
+    def delete_storage_negotiated(self, source: bytes, destination: bytes,
+                                  size: int) -> None:
+        """Roll back one just-recorded negotiation (failed-push
+        compensation in matchmaking fulfill)."""
+        self._run(self._op_delete_storage_negotiated, source, destination,
+                  size)
+
+    def save_snapshot(self, pubkey: bytes, snapshot_hash: bytes) -> None:
+        self._run(self._op_save_snapshot, pubkey, snapshot_hash)
+
+    def get_latest_client_snapshot(self, pubkey: bytes) -> Optional[bytes]:
+        return self._run(self._op_get_latest_client_snapshot, pubkey)
+
+    def get_client_negotiated_peers(self, pubkey: bytes) -> list:
+        return self._run(self._op_get_client_negotiated_peers, pubkey)
+
+    def get_clients_storing_on(self, pubkey: bytes) -> list:
+        """Sources with data on ``pubkey`` (the reverse negotiation
+        edge)."""
+        return self._run(self._op_get_clients_storing_on, pubkey)
+
+    def save_audit_report(self, reporter: bytes, peer: bytes, passed: bool,
+                          detail: str) -> None:
+        self._run(self._op_save_audit_report, reporter, peer, passed,
+                  detail)
+
+    def save_repair_report(self, reporter: bytes, peer: bytes,
+                           packfiles_lost: int, bytes_lost: int,
+                           bytes_replaced: int) -> None:
+        self._run(self._op_save_repair_report, reporter, peer,
+                  packfiles_lost, bytes_lost, bytes_replaced)
+
+    def reclaim_negotiation(self, client: bytes, peer: bytes) -> int:
+        """Retire every negotiation edge between ``client`` and a lost
+        ``peer`` (both directions): the allowance is unusable, and
+        restore peer lists must stop naming the dead peer.  Returns rows
+        removed."""
+        return self._run(self._op_reclaim_negotiation, client, peer)
+
+    def audit_failing_reporters(self, peer: bytes, window_s: float) -> int:
+        """Distinct reporters whose LATEST report on ``peer`` within the
+        window is a failure.  A later pass from the same reporter clears
+        its vote, so a recovered peer re-enters matchmaking without any
+        server-side state surgery."""
+        return self._run(self._op_audit_failing_reporters, peer, window_s)
+
+
+class ServerDB(SqliteServerStore):
+    """The pre-PR-10 direct-mode store, kept name-compatible.
+
+    Everything executes inline on the calling thread with an immediate
+    commit (now under a lock — the original shared its connection across
+    threads unserialized).  ``CoordinationServer(legacy=True)`` and the
+    bench's single-lock baseline leg use this; new code wants
+    :class:`SqliteServerStore`.
+    """
+
+    def __init__(self, path):
+        super().__init__(path, write_behind=False)

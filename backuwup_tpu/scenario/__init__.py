@@ -3,14 +3,20 @@
 ``harness`` runs a scripted multi-client deployment through timed fault
 phases while sampling the durability invariants; ``scorecard`` turns
 the run's registry deltas and samples into a machine-readable pass/fail
-card.  ``scripts/scenario.py`` is the CLI; the ``scenario``-marked
-tests gate the composed scenario in tier 1.
+card.  ``swarm`` re-points the same machinery at the coordination plane:
+N lightweight control-plane clients hammering one server (the PR-10
+scale-out proof).  ``scripts/scenario.py`` is the CLI; the ``scenario``-
+and ``swarm``-marked tests gate the composed runs in tier 1.
 """
 
 from .harness import (Phase, ScenarioHarness, ScenarioSpec,
                       builtin_scenarios, run_scenario)
 from .scorecard import Assertion, Scorecard, build_scorecard
+from .swarm import (MatchLoadSpec, SwarmHarness, SwarmSpec, builtin_swarms,
+                    run_match_load, run_swarm, summarize)
 
 __all__ = ["Phase", "ScenarioHarness", "ScenarioSpec",
            "builtin_scenarios", "run_scenario",
-           "Assertion", "Scorecard", "build_scorecard"]
+           "Assertion", "Scorecard", "build_scorecard",
+           "MatchLoadSpec", "SwarmHarness", "SwarmSpec", "builtin_swarms",
+           "run_match_load", "run_swarm", "summarize"]

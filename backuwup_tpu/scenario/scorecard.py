@@ -70,6 +70,14 @@ COUNTER_FAMILIES = (
     "bkw_recovery_runs_total",
     "bkw_recovery_items_total",
     "bkw_partials_expired_total",
+    # scale-out coordination plane (PR 10): the matchmaking economy's
+    # throughput, deadline-heap expiry, per-route request counts, and
+    # the write-behind store's commit modes (group vs direct is the
+    # swarm bench's off-loop evidence)
+    "bkw_matchmakings_total",
+    "bkw_matchmaking_expired_total",
+    "bkw_server_requests_total",
+    "bkw_server_store_commits_total",
 )
 
 #: Histogram families quantiled in the card.
@@ -81,6 +89,10 @@ HISTOGRAM_FAMILIES = (
     "bkw_peer_transfer_wait_seconds",
     "bkw_peer_transfer_send_seconds",
     "bkw_recovery_seconds",
+    # scale-out coordination plane (PR 10)
+    "bkw_server_request_seconds",
+    "bkw_loop_stall_seconds",
+    "bkw_server_store_batch_ops",
 )
 
 

@@ -235,6 +235,12 @@ def main() -> None:
         record["scenario_passed"] = scenario["passed"]
         record["scenario_violation_seconds"] = \
             scenario.get("violation_seconds", 0)
+    # config #12 is the coordination-plane scale-out gate: surface the
+    # sharded tier's matchmaking throughput and request p99 at top level
+    swarm = configs.get("12_swarm", {})
+    if "matchmakings_per_s" in swarm:
+        record["matchmakings_per_s"] = swarm["matchmakings_per_s"]
+        record["server_p99_ms"] = swarm.get("server_p99_ms")
     print(json.dumps({
         **record,
         "note": "corpus synthesized on-device (host<->device relay tunnel "

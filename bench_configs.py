@@ -24,11 +24,16 @@ identical dedup output is meaningless):
       bench record (durability regression tripwire)
   #10 wan resume          — resume-enabled vs restart-from-zero
       bytes-on-wire across two injected mid-transfer cuts (ratio)
+  #11 crash matrix        — armed commit-seam crashes + recovery sweep
+      cost, scorecard embedded
+  #12 swarm               — sharded vs single-lock coordination plane:
+      direct matchmaking-layer speedup legs plus the HTTP swarm
+      scenario's p99/stall/off-loop-commit evidence (gate: ≥ 2x)
 
 Environment knobs: BENCH_C2_FILES, BENCH_C3_MIB, BENCH_C4_GIB,
 BENCH_C5_HASHES, BENCH_C6_MIB, BENCH_C7_SHARD_KIB, BENCH_C7_STRIPES,
 BENCH_C8_MIB, BENCH_C8_PEERS, BENCH_C8_LATENCY_S, BENCH_C10_KIB,
-BENCH_C10_CHUNK_KIB.
+BENCH_C10_CHUNK_KIB, BENCH_C12_CLIENTS, BENCH_C12_S.
 """
 
 from __future__ import annotations
@@ -923,6 +928,66 @@ def config11_crash(log: Callable) -> Dict:
             "scorecard": card.to_dict()}
 
 
+def config12_swarm(log: Callable) -> Dict:
+    """Sharded vs single-lock coordination plane — config #12.
+
+    Two measurements land in ONE record:
+
+    * **speedup legs** — the matchmaker + store pair driven directly by
+      time-boxed client coroutines (same file-backed sqlite, same fsync
+      discipline, same per-candidate audit-history scan weight in both
+      legs): ``baseline`` is the legacy single-lock StorageQueue over
+      the direct-commit store, ``sharded`` the pubkey-sharded matchmaker
+      over the write-behind store.  The gate is sharded ≥ 2x baseline
+      matchmakings/s.  The legs bypass HTTP deliberately: on a
+      single-core box the identical per-request HTTP/auth cost dominates
+      both tiers and hides the coordination-layer difference.
+    * **swarm evidence** — the full HTTP swarm scenario (register, WS
+      push, seeded request mix, churn) on the sharded tier, embedding
+      the scorecard whose hard gates assert the p99 is measured, the
+      event loop never stalled past budget, and no sqlite commit ran on
+      the loop thread.
+    """
+    import asyncio
+    import dataclasses
+    import tempfile
+    from pathlib import Path
+
+    from backuwup_tpu.scenario import (MatchLoadSpec, builtin_swarms,
+                                       run_match_load, run_swarm)
+
+    clients = int(os.environ.get("BENCH_C12_CLIENTS", "128"))
+    duration_s = float(os.environ.get("BENCH_C12_S", "2.5"))
+
+    spec = MatchLoadSpec(clients=clients, duration_s=duration_s)
+    with tempfile.TemporaryDirectory(prefix="bkw_bench_swarm_") as td:
+        baseline = run_match_load(
+            dataclasses.replace(spec, legacy=True), td)
+        sharded = run_match_load(spec, td)
+        swarm_spec = builtin_swarms()["swarm"]
+        card, swarm = asyncio.run(run_swarm(swarm_spec, Path(td)))
+    speedup = (sharded["matchmakings_per_s"]
+               / max(baseline["matchmakings_per_s"], 1e-9))
+    passed = speedup >= 2.0 and card.passed
+    log(f"config#12 swarm: {clients} clients x {duration_s:.1f}s: "
+        f"baseline {baseline['matchmakings_per_s']:.0f} mm/s, "
+        f"sharded {sharded['matchmakings_per_s']:.0f} mm/s = "
+        f"{speedup:.2f}x; http swarm p99={swarm['server_p99_ms']}ms "
+        f"stall={swarm['max_stall_ms']}ms "
+        f"commits_on_loop={swarm['commits_on_loop']} "
+        f"[{'PASS' if passed else 'FAIL'}]")
+    return {"passed": passed,
+            "matchmakings_per_s": sharded["matchmakings_per_s"],
+            "baseline_matchmakings_per_s": baseline["matchmakings_per_s"],
+            "speedup": round(speedup, 2),
+            "server_p99_ms": swarm["server_p99_ms"],
+            "max_stall_ms": swarm["max_stall_ms"],
+            "commits_on_loop": swarm["commits_on_loop"],
+            "legs": {"baseline": baseline, "sharded": sharded},
+            "swarm": swarm,
+            "scorecard": card.to_dict()}
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -938,7 +1003,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("8_transfer", lambda: config8_transfer(log)),
             ("9_scenario", lambda: config9_scenario(log)),
             ("10_wan", lambda: config10_wan(log)),
-            ("11_crash", lambda: config11_crash(log))):
+            ("11_crash", lambda: config11_crash(log)),
+            ("12_swarm", lambda: config12_swarm(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

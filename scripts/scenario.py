@@ -25,13 +25,14 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from backuwup_tpu.obs import journal as obs_journal  # noqa: E402
 from backuwup_tpu.obs import timeline as obs_timeline  # noqa: E402
-from backuwup_tpu.scenario import builtin_scenarios, run_scenario  # noqa: E402
+from backuwup_tpu.scenario import (builtin_scenarios, builtin_swarms,  # noqa: E402
+                                   run_scenario, run_swarm)
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", default="composed",
-                    help="scenario name (see --list)")
+                    help="scenario or swarm name (see --list)")
     ap.add_argument("--list", action="store_true",
                     help="list built-in scenarios and exit")
     ap.add_argument("--seed", type=int, default=None,
@@ -48,22 +49,32 @@ def main() -> int:
     args = ap.parse_args()
 
     scenarios = builtin_scenarios()
+    swarms = builtin_swarms()
     if args.list:
-        for name, spec in scenarios.items():
-            print(f"{name:10s} seed={spec.seed:<4d} "
+        for name, spec in {**scenarios, **swarms}.items():
+            kind = "swarm" if name in swarms else "chaos"
+            print(f"{name:12s} {kind:5s} seed={spec.seed:<4d} "
                   f"phases={'/'.join(p.label for p in spec.phases)}")
         return 0
-    spec = scenarios.get(args.scenario)
+    spec = scenarios.get(args.scenario) or swarms.get(args.scenario)
     if spec is None:
         print(f"unknown scenario {args.scenario!r}; try --list",
               file=sys.stderr)
         return 2
     if args.seed is not None:
         spec = dataclasses.replace(spec, seed=args.seed)
+    is_swarm = args.scenario in swarms
+
+    async def run_spec(workdir: Path):
+        if is_swarm:
+            card, summary = await run_swarm(spec, workdir)
+            print(" ".join(f"{k}={v}" for k, v in summary.items()))
+            return card
+        return await run_scenario(spec, workdir)
 
     def run_in(workdir: Path):
         if not args.profile:
-            return asyncio.run(run_scenario(spec, workdir))
+            return asyncio.run(run_spec(workdir))
         # every client in the harness shares this process, so one
         # installed journal captures all sides' spans; the timeline
         # export then shows pack/seal/send/store overlap across peers,
@@ -71,7 +82,7 @@ def main() -> int:
         jr = obs_journal.install(
             obs_journal.Journal(workdir / "scenario_journal.jsonl"))
         try:
-            return asyncio.run(run_scenario(spec, workdir))
+            return asyncio.run(run_spec(workdir))
         finally:
             obs_journal.uninstall()
             doc = obs_timeline.export_timeline(

@@ -88,6 +88,19 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "crash bench recapture FAILED (see $crs) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated swarm recapture: config #12 alone (host-only
+        # coordination plane: sharded-vs-single-lock matchmaking speedup
+        # legs + the HTTP swarm's p99/stall/off-loop-commit evidence) —
+        # the scale-out gate verdict survives even when the device suite
+        # timed out partway
+        swm="$BENCH_OUT_DIR/BENCH_swarm_${stamp}.json"
+        if timeout "${BENCH_SWARM_TIMEOUT_S:-600}" \
+                env BENCH_ONLY_CONFIG=12_swarm BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$swm" 2>>/tmp/tpu_watch.log; then
+            echo "swarm bench recaptured to $swm at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "swarm bench recapture FAILED (see $swm) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

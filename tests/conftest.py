@@ -68,6 +68,10 @@ def pytest_configure(config):
         " the unit recoveries and the representative scenario subset are"
         " tier-1, the full matrix and the kill-9 e2e are also slow")
     config.addinivalue_line(
+        "markers", "swarm: coordination-plane swarm runs (scenario/"
+        "swarm.py); the ~32-client acceptance run is tier-1, the full"
+        " load shape is also marked slow")
+    config.addinivalue_line(
         "markers", "profile: timing-sensitive profiling tests"
         " (obs/profile.py dev timer); excluded from tier-1 like accel —"
         " set BKW_PROFILE_TESTS=1 to run them")

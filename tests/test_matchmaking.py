@@ -1,0 +1,308 @@
+"""Sharded matchmaking + write-behind server store (net/matchmaking.py,
+net/serverstore.py — the PR-10 scale-out of the coordination plane).
+
+Covers the satellites:
+
+* the latent ServerDB thread-safety hole (one sqlite connection shared
+  across request threads with ``check_same_thread=False`` and no
+  serialization) — both store modes are hammered from many threads;
+* write-behind group commit: many concurrent writes, few commits, all
+  durable, and every commit on the single writer thread;
+* matchmaking semantics parity on the sharded tier (audit-block,
+  rollback on candidate push failure, re-enqueue on requester push
+  failure), cross-shard work stealing, fairness under a large request
+  queued behind many small ones, and O(log n) deadline-heap expiry.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from backuwup_tpu import defaults
+from backuwup_tpu.net.matchmaking import ShardedMatchmaker
+from backuwup_tpu.net.serverstore import (_COMMITS, ServerDB,
+                                          SqliteServerStore)
+
+MIB = 1 << 20
+
+
+def pk(i: int) -> bytes:
+    """Pubkey whose home shard is ``i % shards`` (the shard key is the
+    first 8 bytes big-endian mod N)."""
+    return i.to_bytes(8, "big") + bytes(24)
+
+
+class StubConns:
+    """Connection registry double: scripted offline sets, scripted push
+    failures, and a per-client log of delivered matches."""
+
+    def __init__(self):
+        self.offline = set()
+        self.fail_notify = set()
+        self.notified = {}
+
+    def is_online(self, client_id) -> bool:
+        return bytes(client_id) not in self.offline
+
+    async def notify(self, client_id, msg) -> bool:
+        await asyncio.sleep(0)
+        if bytes(client_id) in self.fail_notify:
+            return False
+        self.notified.setdefault(bytes(client_id), []).append(msg)
+        return True
+
+    def count(self, client_id) -> int:
+        return len(self.notified.get(bytes(client_id), []))
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+# --- store thread-safety + group commit ------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["write_behind", "direct"])
+def test_concurrent_writers_hammer(tmp_path, mode):
+    """The legacy ServerDB shared one sqlite connection across request
+    threads unserialized; the store now either funnels every op through
+    the single writer thread (write-behind) or serializes inline ops
+    under a lock (direct).  50 writes from each of 8 threads must all
+    land, with no lost updates and no sqlite thread errors."""
+    store = (SqliteServerStore(str(tmp_path / "s.db"))
+             if mode == "write_behind"
+             else ServerDB(str(tmp_path / "d.db")))
+    threads, per_thread = 8, 50
+    errors = []
+
+    def slam(t: int) -> None:
+        try:
+            for i in range(per_thread):
+                store.save_storage_negotiated(pk(t), pk(1000 + t * per_thread + i), MIB)
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(repr(e))
+
+    try:
+        ts = [threading.Thread(target=slam, args=(t,))
+              for t in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not errors, errors
+        for t in range(threads):
+            peers = store.get_client_negotiated_peers(pk(t))
+            assert len(peers) == per_thread
+        if mode == "write_behind":
+            # every commit ran on the one writer thread, never here
+            assert threading.get_ident() not in store.commit_threads
+            assert len(store.commit_threads) == 1
+    finally:
+        store.close()
+
+
+def test_group_commit_batches_writes(tmp_path):
+    """A burst of writes submitted faster than fsync must coalesce into
+    far fewer commits than writes — and still all be readable after
+    ``flush()`` (the durability barrier resolves futures post-commit)."""
+    store = SqliteServerStore(str(tmp_path / "s.db"))
+    writes = 300
+    before = _COMMITS.value(mode="group")
+    try:
+        futs = [store._submit(store._op_save_storage_negotiated,
+                              (pk(1), pk(100 + i), MIB))
+                for i in range(writes)]
+        store.flush()
+        for f in futs:
+            f.result(timeout=10)
+        commits = _COMMITS.value(mode="group") - before
+        assert commits >= 1
+        assert commits <= writes / 2, \
+            f"{commits} commits for {writes} writes: no batching"
+        assert len(store.get_client_negotiated_peers(pk(1))) == writes
+    finally:
+        store.close()
+
+
+def test_store_readable_after_close(tmp_path):
+    """``close()`` stops the writer but keeps the connection for reads
+    (the server's stop path reads schema_version for its final log)."""
+    store = SqliteServerStore(str(tmp_path / "s.db"))
+    store.save_storage_negotiated(pk(1), pk(2), MIB)
+    store.close()
+    assert len(store.get_client_negotiated_peers(pk(1))) == 1
+    store.close()  # idempotent
+
+
+# --- sharded matchmaking ----------------------------------------------------
+
+
+def _mm(store, conns, shards=4, expiry_s=60.0):
+    return ShardedMatchmaker(store, conns, expiry_s=expiry_s, shards=shards)
+
+
+def test_cross_shard_work_stealing(tmp_path, loop):
+    """A queued request homed on one shard is matched by a requester
+    homed on another: the ring walk visits every shard."""
+    store = SqliteServerStore(str(tmp_path / "s.db"))
+    conns = StubConns()
+    mm = _mm(store, conns, shards=4)
+    try:
+        async def run():
+            await mm.fulfill(pk(1), MIB)       # home shard 1: queues
+            assert mm.pending() == 1
+            await mm.fulfill(pk(2), MIB)       # home shard 2: steals it
+            assert mm.pending() == 0
+
+        loop.run_until_complete(run())
+        assert conns.count(pk(1)) == 1 and conns.count(pk(2)) == 1
+        assert len(store.get_client_negotiated_peers(pk(1))) == 1
+    finally:
+        store.close()
+
+
+def test_large_request_behind_many_small_still_fulfills(tmp_path, loop):
+    """Fairness: a large queued request sitting behind many small ones
+    (across all shards) is not starved — incoming requesters drain the
+    small entries and then the large one, in pieces."""
+    store = SqliteServerStore(str(tmp_path / "s.db"))
+    conns = StubConns()
+    mm = _mm(store, conns, shards=4)
+    small_ids = [pk(i) for i in range(10, 22)]
+    big = pk(5)
+    try:
+        async def run():
+            # small requests arrive first (they pair off with each other
+            # as they come; any leftover stays queued ahead of big)
+            for cid in small_ids:
+                await mm.fulfill(cid, MIB)
+            await mm.fulfill(big, 8 * MIB)  # queues behind the backlog
+            assert any(e[0] == big for s in mm.shards
+                       for e in s.entries.values())
+            # requesters keep arriving; the big entry must drain too
+            for i in range(40):
+                if not any(e[0] == big for s in mm.shards
+                           for e in s.entries.values()):
+                    break
+                await mm.fulfill(pk(100 + i), MIB)
+            assert not any(e[0] == big for s in mm.shards
+                           for e in s.entries.values()), "big entry starved"
+            assert conns.count(big) >= 1
+
+        loop.run_until_complete(run())
+    finally:
+        store.close()
+
+
+def test_deadline_heap_expiry_is_olog(tmp_path, loop):
+    """Expiry pops the deadline heap exactly once per expired entry —
+    never a rescan of live entries: ``reap_ops`` equals the expired
+    count and stays flat across repeated ``pending()`` calls."""
+    store = SqliteServerStore(str(tmp_path / "s.db"))
+    mm = _mm(store, StubConns(), shards=2, expiry_s=60.0)
+    try:
+        now = time.time()
+        for i in range(50):  # expire almost immediately
+            mm.shards[i % 2].add(i, pk(i), MIB, now + 0.01)
+        for i in range(50, 60):  # live for the whole test
+            mm.shards[i % 2].add(i, pk(i), MIB, now + 60.0)
+        time.sleep(0.03)
+        assert mm.pending() == 10
+        assert mm.reap_ops() == 50
+        for _ in range(5):  # repeated sweeps do no per-entry work
+            assert mm.pending() == 10
+        assert mm.reap_ops() == 50
+    finally:
+        store.close()
+
+
+def test_audit_blocked_candidate_dropped(tmp_path, loop):
+    """A queued candidate reported failing by >= the block threshold of
+    DISTINCT reporters is dropped at pop, not matched."""
+    store = SqliteServerStore(str(tmp_path / "s.db"))
+    conns = StubConns()
+    mm = _mm(store, conns)
+    bad, requester = pk(1), pk(2)
+    try:
+        async def run():
+            await mm.fulfill(bad, MIB)  # queues
+            for r in range(defaults.AUDIT_SERVER_BLOCK_FAILURES):
+                await store.aio.save_audit_report(pk(50 + r), bad, False, "")
+            await mm.fulfill(requester, MIB)
+
+        loop.run_until_complete(run())
+        assert conns.count(bad) == 0
+        assert len(store.get_client_negotiated_peers(requester)) == 0
+        # the requester could not match and is queued itself
+        assert mm.pending() == 1
+    finally:
+        store.close()
+
+
+def test_candidate_push_failure_rolls_back(tmp_path, loop):
+    store = SqliteServerStore(str(tmp_path / "s.db"))
+    conns = StubConns()
+    mm = _mm(store, conns)
+    dead, requester = pk(1), pk(2)
+    conns.fail_notify.add(bytes(dead))
+    try:
+        async def run():
+            await mm.fulfill(dead, MIB)
+            await mm.fulfill(requester, MIB)
+
+        loop.run_until_complete(run())
+        # both negotiation records rolled back, dead's entry dropped,
+        # requester re-queued
+        assert len(store.get_client_negotiated_peers(requester)) == 0
+        assert len(store.get_client_negotiated_peers(dead)) == 0
+        assert conns.count(requester) == 0
+        assert mm.pending() == 1
+    finally:
+        store.close()
+
+
+def test_requester_push_failure_keeps_record_requeues_candidate(
+        tmp_path, loop):
+    store = SqliteServerStore(str(tmp_path / "s.db"))
+    conns = StubConns()
+    mm = _mm(store, conns)
+    cand, requester = pk(1), pk(2)
+    conns.fail_notify.add(bytes(requester))
+    try:
+        async def run():
+            await mm.fulfill(cand, 2 * MIB)
+            await mm.fulfill(requester, MIB)
+
+        loop.run_until_complete(run())
+        # the candidate heard about the match, so the record stays
+        assert conns.count(cand) == 1
+        assert len(store.get_client_negotiated_peers(requester)) == 1
+        # and its unmatched remainder went back in the queue
+        assert mm.pending() == 1
+        entries = [e for s in mm.shards for e in s.entries.values()]
+        assert entries[0][0] == cand and entries[0][1] == MIB
+    finally:
+        store.close()
+
+
+def test_offline_entries_dropped_at_pop(tmp_path, loop):
+    store = SqliteServerStore(str(tmp_path / "s.db"))
+    conns = StubConns()
+    mm = _mm(store, conns)
+    ghost, requester = pk(1), pk(2)
+    try:
+        async def run():
+            await mm.fulfill(ghost, MIB)
+            conns.offline.add(bytes(ghost))
+            await mm.fulfill(requester, MIB)
+
+        loop.run_until_complete(run())
+        assert conns.count(ghost) == 0
+        assert mm.pending() == 1  # only the requester remains queued
+    finally:
+        store.close()
