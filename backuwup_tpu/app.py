@@ -71,6 +71,7 @@ class ClientApp:
         self.node = P2PNode(self.keys, self.store, self.server)
         self.node.on_transport_request = self._accept_peer_data
         self.node.on_restore_request = self._serve_restore
+        self.node.on_restore_fetch_request = self._serve_restore_fetch
         self.node.on_audit_request = self._serve_audit
         self.server.on_backup_matched = self._backup_matched
         self.server.on_audit_due = self._audit_due
@@ -183,6 +184,12 @@ class ClientApp:
         sent = await self.node.serve_restore(source, transport)
         self.messenger.log(
             f"served {sent} files back to {bytes(source).hex()[:8]}")
+
+    async def _serve_restore_fetch(self, source: bytes, transport) -> None:
+        sent = await self.node.serve_restore_fetch(source, transport)
+        self.messenger.log(
+            f"served {sent} fetched item(s) back to "
+            f"{bytes(source).hex()[:8]}")
 
     async def _serve_audit(self, source: bytes, transport) -> None:
         answered = await self.node.serve_audit(source, transport,

@@ -114,6 +114,30 @@ TRANSFER_MIN_THROUGHPUT_BPS = 256 * KiB
 TRANSFER_DEADLINE_SAFETY = 0.25
 TRANSFER_DEADLINE_CAP_S = 600.0
 
+# --- restore data plane (engine.run_restore planner, net/transfer.py
+# download lanes; docs/transfer.md restore data plane) ------------------------
+# Per-stripe source fan-out: each stripe's shards are pulled from its k
+# currently-fastest live holders (k = the stripe's data-shard count); the
+# remaining m holders are held back as hedge spares.  When a pull has been
+# running for this fraction of its adaptive deadline without finishing, a
+# redundant pull of a spare shard is launched and the first completion
+# wins — the stall is raced, not waited out.
+RESTORE_HEDGE_DEADLINE_FRACTION = 0.5
+# Re-queue budget for a stalled/failed shard download before the stripe
+# falls back to whole-copy RESTORE_ALL sources (each retry prefers a
+# holder that has not failed this shard yet).
+RESTORE_FETCH_RETRIES = 2
+# Serve-side throttle for RESTORE_FETCH sessions.  Deliberately decoupled
+# from RESTORE_REQUEST_THROTTLE_S and off by default: one multi-source
+# restore legitimately opens several fetch connections to the same holder
+# in quick succession (per-stripe pulls, hedges, the index sweep), and a
+# fetch serves only the named items, so the abuse surface is bounded.
+# Operators worried about hostile pullers can raise it.
+RESTORE_FETCH_MIN_INTERVAL_S = 0.0
+# Upper bound on items one FETCH_REQUEST may name (mirrors the audit
+# batch bound: reject absurd batches before doing any disk work).
+RESTORE_FETCH_MAX_WANTS = 4096
+
 # --- capacity-aware placement (store.find_peers_with_storage,
 # net/peer_stats.py; docs/transfer.md) ----------------------------------------
 # Peers are ranked by log2-bucketed (EWMA throughput x success ratio) with

@@ -55,9 +55,10 @@ from .net.p2p import (
     RestoreFilesWriter,
     SendProgress,
     Transport,
+    adaptive_deadline,
 )
 from .net.peer_stats import PeerStats
-from .net.transfer import BYTES_RESENT, TransferScheduler
+from .net.transfer import BYTES_RESENT, RESTORE_SOURCES, TransferScheduler
 from .obs import invariants as obs_invariants
 from .obs import journal as obs_journal
 from .obs import metrics as obs_metrics
@@ -724,55 +725,61 @@ class Engine:
             return 0.0
         return est.throughput_bps
 
+    def _pull_rate(self, peer_id: bytes) -> float:
+        """Source-selection score for download lanes: EWMA throughput
+        derated by success ratio, with the neutral placement prior for
+        never-measured peers so fresh holders stay schedulable between
+        measured-fast and measured-slow ones."""
+        est = self.peer_stats.get(peer_id) if self.peer_stats else None
+        if est is None or est.samples < defaults.PLACEMENT_MIN_SAMPLES:
+            return float(defaults.PLACEMENT_NEUTRAL_SCORE_BPS)
+        return max(est.throughput_bps * max(est.success, 0.0), 1.0)
+
+    def _dial_budget(self, peer_id: bytes) -> float:
+        """Adaptive dial budget (the PR 8 deadline policy applied to the
+        rendezvous confirm): the base ack window plus the peer's measured
+        EWMA latency derated by the transfer safety fraction, under the
+        transfer deadline cap.  Replaces the old fixed 10 s guess so a
+        slow-but-alive peer is not misclassified as dark while a truly
+        dark one still fails within seconds."""
+        est = self.peer_stats.get(peer_id) if self.peer_stats else None
+        lat = 0.0
+        if est is not None and est.samples > 0:
+            lat = float(est.latency_s) / max(
+                defaults.TRANSFER_DEADLINE_SAFETY, 1e-6)
+        return min(defaults.TRANSFER_DEADLINE_CAP_S,
+                   defaults.ACK_TIMEOUT_S + lat)
+
     async def _send_resumable(self, orch: Orchestrator, transport,
                               peer_id: bytes, data: bytes,
                               file_info: wire.FileInfoKind,
                               file_id: bytes) -> None:
-        """``send_file`` with the abort-and-resume loop around it.
-
-        A mid-transfer failure (cut link, stalled ack) drops the poisoned
-        transport, redials, and continues the chunked send from the
-        receiver's verified offset — up to TRANSFER_RESUME_ATTEMPTS
-        reconnects before the failure surfaces to the scheduler.  Bytes
-        shipped more than once across attempts are accounted to
-        ``bkw_transfer_bytes_resent_total`` (the wan scenario's budget).
-        """
+        """The shared abort-and-resume loop
+        (``TransferScheduler.run_resumable``) with this engine's
+        connection bookkeeping plugged in: a failed attempt drops the
+        poisoned transport from the orchestrator and a retry redials,
+        registering the fresh transport so sibling jobs reuse it."""
         peer_id = bytes(peer_id)
-        tput = self._peer_throughput(peer_id)
-        resume = bool(defaults.TRANSFER_RESUME_ENABLED)
-        attempts = int(defaults.TRANSFER_RESUME_ATTEMPTS)
-        hwm = 0  # high-water wire offset across attempts
-        t = transport
-        for attempt in range(attempts + 1):
-            prog = SendProgress()
+
+        async def on_drop() -> None:
+            await self._drop_transport(orch, peer_id)
+
+        async def redial():
+            if self.node is None:
+                raise P2PError("reconnect for resume failed: engine closed")
             try:
-                await t.send_file(data, file_info, file_id, resume=resume,
-                                  throughput_bps=tput, progress=prog)
-                BYTES_RESENT.inc(max(0, min(prog.offset, hwm)
-                                     - prog.started))
-                return
-            except P2PError as e:
-                # the overlap between this attempt's shipped range and
-                # anything shipped before is waste the resume plane
-                # failed to avoid
-                BYTES_RESENT.inc(max(0, min(prog.offset, hwm)
-                                     - prog.started))
-                hwm = max(hwm, prog.offset)
-                await self._drop_transport(orch, peer_id)
-                if attempt >= attempts or self.node is None:
-                    raise
-                obs_journal.emit("transfer_resume",
-                                 peer=peer_id.hex()[:16],
-                                 attempt=attempt + 1,
-                                 offset=prog.offset, error=str(e))
-                try:
-                    t = await self.node.connect(
-                        peer_id, wire.RequestType.TRANSPORT, timeout=3.0)
-                except (P2PError, ServerError, OSError,
-                        asyncio.TimeoutError) as e2:
-                    raise P2PError(
-                        f"reconnect for resume failed: {e2}") from e2
-                orch.active_transports[peer_id] = t
+                t = await self.node.connect(
+                    peer_id, wire.RequestType.TRANSPORT, timeout=3.0)
+            except (P2PError, ServerError, OSError,
+                    asyncio.TimeoutError) as e:
+                raise P2PError(f"reconnect for resume failed: {e}") from e
+            orch.active_transports[peer_id] = t
+            return t
+
+        await TransferScheduler.run_resumable(
+            transport, peer_id, data, file_info, file_id,
+            throughput_bps=self._peer_throughput(peer_id),
+            redial=redial, on_drop=on_drop)
 
     def _whole_file_job(self, orch: Orchestrator, transport, peer_id: bytes,
                         pid: bytes, path: Path, size: int):
@@ -1403,38 +1410,64 @@ class Engine:
                 entry[idx] = (b"", est)
 
     async def _rebuild_lost_shards(self, stripe_lost: Dict, lost: set):
-        """Sourceless shard repair: pull each damaged stripe's surviving
-        shards from their holders (the same RESTORE_ALL machinery a full
-        restore uses, staged privately), decode + re-encode the lost rows
-        — byte-identical, so the pre-computed challenge tables stay valid
-        — and place them on fresh peers.  The local source tree is never
-        touched.  Returns ``(shards rebuilt, bytes placed, pids needing
-        the re-pack-from-source fallback)``.
+        """Sourceless shard repair on the restore data plane: pull the k
+        survivor shards each damaged stripe needs, shard-granular
+        (RESTORE_FETCH through the same download lanes a restore uses —
+        fastest holders first, hedged stalls, re-queue on failure),
+        staged privately; decode + re-encode the lost rows —
+        byte-identical, so the pre-computed challenge tables stay valid —
+        and place them on fresh peers.  Stripes are processed in the
+        durability monitor's at-risk order (fewest clean survivors
+        first), so the data closest to unrestorable re-homes first.  The
+        local source tree is never touched.  Returns ``(shards rebuilt,
+        bytes placed, pids needing the re-pack-from-source fallback)``.
         """
         staging = self.store.data_base / "repair_staging"
         shutil.rmtree(staging, ignore_errors=True)
         staging.mkdir(parents=True, exist_ok=True)
-        # one pull per surviving holder covers every stripe it touches
-        sources = set()
-        for pidb in stripe_lost:
-            for p, i in self.store.shards_for_packfile(pidb):
-                if i >= 0 and bytes(p) not in lost:
-                    sources.add(bytes(p))
         writer = RestoreFilesWriter(self.store, base=staging)
-        for peer_id in sorted(sources):
-            try:
-                t = await self.node.connect(
-                    peer_id, wire.RequestType.RESTORE_ALL, timeout=10.0)
-                try:
-                    await Receiver(t, writer.sink,
-                                   part_sink=writer.sink_part,
-                                   resume_query=writer.resume_offer).run()
-                finally:
-                    await t.close()
-            except (P2PError, ServerError, OSError,
-                    asyncio.TimeoutError) as e:
-                self._log(f"repair fetch from {peer_id.hex()[:8]}"
-                          f" failed: {e}")
+        survivors: Dict[bytes, list] = {}
+        for pidb in stripe_lost:
+            survivors[pidb] = [
+                (bytes(p), i)
+                for p, i in self.store.shards_for_packfile(pidb)
+                if i >= 0 and bytes(p) not in lost]
+        at_risk = sorted(stripe_lost,
+                         key=lambda pidb: len(survivors[pidb]))
+        pull_sched = TransferScheduler(messenger=self.messenger,
+                                       peer_stats=self.peer_stats)
+        streamed: set = set()
+        for pidb in at_risk:
+            est = max((s for _p, s in stripe_lost[pidb].values()),
+                      default=0)
+            shard_map = {i: (p, est) for p, i in survivors[pidb]}
+            got = 0
+            if shard_map:
+                got = await self._pull_stripe(pidb, shard_map, writer,
+                                              pull_sched)
+            if got < min(defaults.RS_K, len(shard_map)):
+                # shard pulls came up short: fall back to full
+                # RESTORE_ALL streams from this stripe's untapped holders
+                # (also the interop path for peers predating the fetch
+                # protocol)
+                for peer_id in sorted({p for p, _i in survivors[pidb]}
+                                      - streamed):
+                    try:
+                        t = await self.node.connect(
+                            peer_id, wire.RequestType.RESTORE_ALL,
+                            timeout=self._dial_budget(peer_id))
+                        try:
+                            await Receiver(
+                                t, writer.sink,
+                                part_sink=writer.sink_part,
+                                resume_query=writer.resume_offer).run()
+                        finally:
+                            await t.close()
+                        streamed.add(peer_id)
+                    except (P2PError, ServerError, OSError,
+                            asyncio.TimeoutError) as e:
+                        self._log(f"repair fetch from {peer_id.hex()[:8]}"
+                                  f" failed: {e}")
         rebuilt = 0
         placed_bytes = 0
         unrebuildable = []
@@ -1450,7 +1483,8 @@ class Engine:
                     if f.is_file()]
 
         try:
-            for pidb, lost_map in stripe_lost.items():
+            for pidb in at_risk:
+                lost_map = stripe_lost[pidb]
                 shard_dir = staging / "shard" / pidb.hex()
                 blobs = await self._blocking(read_staged, shard_dir)
                 missing = sorted(lost_map)
@@ -1603,64 +1637,68 @@ class Engine:
         if last is not None and \
                 time.time() - last < defaults.RESTORE_REQUEST_THROTTLE_S:
             raise EngineError("restore requested too recently")
-        self.store.add_event(EVENT_RESTORE_REQUEST, {})
         try:
             info = await self.server.backup_restore()
         except NoBackups:
             raise EngineError("no snapshot recorded on server")
         if info.snapshot_hash is None:
             raise EngineError("no snapshot recorded on server")
+        # throttle only once a snapshot is actually negotiated: a
+        # NoBackups or network error must not burn the user's one
+        # restore-request slot per window
+        self.store.add_event(EVENT_RESTORE_REQUEST, {})
         peers = [bytes.fromhex(p) for p in info.peers]
         if not peers:
             raise EngineError("no peers hold our data")
         writer = RestoreFilesWriter(self.store)
-        # concurrent fan-out to every negotiated peer with a per-peer
-        # completion map (backup/mod.rs:141-161, restore_orchestrator.rs:
-        # 16-19); the restore proceeds only when every peer's stream has
-        # landed — each peer holds a disjoint part of the backup, so a
-        # missing stream would unpack a hole
-        completed: Dict[bytes, bool] = {p: False for p in peers}
-
-        async def pull(peer_id: bytes) -> None:
-            t = await self.node.connect(peer_id,
-                                        wire.RequestType.RESTORE_ALL,
-                                        timeout=10.0)
-            try:
-                await Receiver(t, writer.sink,
-                               part_sink=writer.sink_part,
-                               resume_query=writer.resume_offer).run()
-            finally:
-                await t.close()
-            completed[peer_id] = True
-            self._log(f"peer {peer_id.hex()[:8]} restore stream complete")
-
-        results = await asyncio.gather(*(pull(p) for p in peers),
-                                       return_exceptions=True)
-        for peer_id, res in zip(peers, results):
-            if isinstance(res, BaseException):
-                self._log(f"restore from {peer_id.hex()[:8]} failed: {res}")
+        plan = self._restore_plan()
+        streamed: set = set()
+        if plan is not None:
+            # shard-granular pull plan over the local placement map:
+            # each stripe from its k fastest holders with hedged spares,
+            # whole-copy peers as single batched pulls
+            stripes, whole, known = plan
+            await self._pull_striped_restore(stripes, whole, writer)
+            legacy_peers = [p for p in peers if p not in known]
+        else:
+            # no placement map (disaster recovery onto a fresh identity):
+            # only the negotiated peer list exists, so every peer pushes
+            # its whole stream (and old peers only speak this path)
+            legacy_peers = list(peers)
+        if legacy_peers:
+            streamed = await self._pull_restore_all(legacy_peers, writer)
         # erasure assembly BEFORE coverage is judged: any k valid shards
         # of a stripe reconstruct its packfile into the pack tree, so up
         # to m dark peers per stripe cost nothing
         await self._assemble_restored_stripes()
-        missing = [p for p, done in completed.items() if not done]
-        if missing:
-            # Failed streams are fatal ONLY if the snapshot is actually
-            # incomplete: a negotiated peer that stores nothing for us (the
-            # matcher's save/notify crash window in net/server.py) refuses
-            # the dial, but the data the other peers returned still covers
-            # the snapshot — verify coverage before giving up.
+        # Coverage decides success, not per-peer completion: shard pulls
+        # deliberately skip n-k holders per stripe, and a negotiated peer
+        # that stores nothing for us (the matcher's save/notify crash
+        # window in net/server.py) refuses the dial while the data the
+        # others returned still covers the snapshot.
+        need_check = plan is not None or len(streamed) < len(legacy_peers)
+        if need_check:
             ctx = self._restored_ctx()
             gap = self._restored_coverage_gap(info.snapshot_hash, ctx)
+            if gap is not None and plan is not None:
+                # fetch-plane shortfall: fall back to full RESTORE_ALL
+                # streams from every peer that has not streamed yet
+                fallback = [p for p in peers if p not in streamed]
+                if fallback:
+                    self._log("restore coverage gap after shard pulls;"
+                              " falling back to full streams")
+                    streamed |= await self._pull_restore_all(fallback,
+                                                             writer)
+                    await self._assemble_restored_stripes()
+                    ctx = self._restored_ctx()
+                    gap = self._restored_coverage_gap(info.snapshot_hash,
+                                                      ctx)
             if gap is not None:
+                missing = [p for p in peers if p not in streamed]
                 raise EngineError(
                     "restore incomplete; no stream from: "
                     + ", ".join(p.hex()[:8] for p in missing)
                     + f"; first missing blob {gap.hex()}")
-            self._log(
-                "unreachable peers: "
-                + ", ".join(p.hex()[:8] for p in missing)
-                + "; restored data covers the snapshot, proceeding")
         else:
             ctx = None
         path = self._unpack_restored(info.snapshot_hash, dest, ctx)
@@ -1668,6 +1706,214 @@ class Engine:
         # (backup/mod.rs:180); a failed unpack keeps it for retry/forensics
         shutil.rmtree(self.store.restore_dir(), ignore_errors=True)
         return path
+
+    # --- restore data plane: the pull planner (docs/transfer.md) -----------
+
+    def _restore_plan(self):
+        """``(stripes, whole, known_peers)`` from the local placement map,
+        or None when the map is empty and only the legacy full-stream
+        path can run.  ``stripes`` maps pid -> shard index -> (holder,
+        size); ``whole`` maps peer -> pid -> size for packfiles with no
+        stripe rows (when both exist the striped pull is preferred — the
+        whole copy stays a coverage-gap fallback source)."""
+        stripes: Dict[bytes, Dict[int, tuple]] = {}
+        whole_rows: Dict[bytes, Dict[bytes, int]] = {}
+        known: set = set()
+        for pid, peer, size, idx, _sent in self.store.all_placements():
+            pidb, peerb = bytes(pid), bytes(peer)
+            known.add(peerb)
+            if idx >= 0:
+                stripes.setdefault(pidb, {})[int(idx)] = (peerb, int(size))
+            else:
+                whole_rows.setdefault(peerb, {})[pidb] = int(size)
+        if not stripes and not whole_rows:
+            return None
+        whole = {
+            peer: {pid: s for pid, s in pids.items() if pid not in stripes}
+            for peer, pids in whole_rows.items()}
+        whole = {peer: pids for peer, pids in whole.items() if pids}
+        return stripes, whole, known
+
+    @staticmethod
+    def _restore_dest(writer: RestoreFilesWriter,
+                      file_info: wire.FileInfoKind, file_id: bytes) -> Path:
+        """Where ``writer.sink`` lands one file — the puller's existence
+        check for 'did the named want actually come back'."""
+        if file_info == wire.FileInfoKind.INDEX:
+            num = int.from_bytes(bytes(file_id)[:8], "little")
+            return writer.dir / "index" / f"{num:06d}"
+        if file_info == wire.FileInfoKind.SHARD:
+            pid, idx = bytes(file_id)[:-1], bytes(file_id)[-1]
+            return writer.dir / "shard" / pid.hex() / f"{idx:03d}"
+        h = bytes(file_id).hex()
+        return writer.dir / "pack" / h[:2] / h
+
+    def _fetch_job(self, peer_id: bytes, wants: list,
+                   writer: RestoreFilesWriter, size_hint: int):
+        """One RESTORE_FETCH pull as a schedulable download: connect
+        under the adaptive dial budget, name the wants, receive under the
+        adaptive transfer deadline, then verify every named want landed
+        (a gap raises, so the scheduler re-queues it elsewhere).  Returns
+        the bytes received for the estimators."""
+        peer_id = bytes(peer_id)
+        paths = [self._restore_dest(writer, k, f)
+                 for k, f in wants if f]
+
+        async def job() -> int:
+            if self.node is None:
+                raise P2PError("engine closed")
+            deadline = adaptive_deadline(size_hint,
+                                         self._peer_throughput(peer_id))
+            t = await self.node.connect(
+                peer_id, wire.RequestType.RESTORE_FETCH,
+                timeout=self._dial_budget(peer_id))
+            try:
+                await self.node.request_fetch(t, wants)
+                await asyncio.wait_for(
+                    Receiver(t, writer.sink, part_sink=writer.sink_part,
+                             resume_query=writer.resume_offer).run(),
+                    deadline)
+            finally:
+                await t.close()
+
+            def landed() -> int:
+                got = 0
+                for p in paths:
+                    if not p.exists():
+                        raise P2PError(
+                            f"peer {peer_id.hex()[:8]} did not return"
+                            f" {p.name}")
+                    got += p.stat().st_size
+                return got
+
+            return await self._blocking(landed)
+        return job
+
+    async def _pull_stripe(self, pidb: bytes, shard_map: Dict,
+                           writer: RestoreFilesWriter,
+                           sched: TransferScheduler) -> int:
+        """Pull one stripe's shards k-of-n: the k fastest holders are the
+        primaries, the rest are spares — a primary that stalls past the
+        hedge fraction of its adaptive deadline races a redundant spare
+        shard, and an outright failure re-queues behind the remaining
+        spares.  Returns the number of shards landed (≥ k restores the
+        stripe; fewer surfaces later as a coverage gap)."""
+        k = min(defaults.RS_K, len(shard_map))
+        ranked = sorted(shard_map.items(),
+                        key=lambda kv: self._pull_rate(kv[1][0]),
+                        reverse=True)
+        primaries, spares = ranked[:k], ranked[k:]
+        spare_iter = iter(spares)
+        delivered: list = []
+
+        def submit_one(idx: int, holder: bytes, size: int):
+            sid = rs_stripe.shard_id(pidb, idx)
+            wants = [(wire.FileInfoKind.SHARD, sid)]
+            return sched.submit_pull(
+                holder, size, self._fetch_job(holder, wants, writer, size),
+                label=f"restore:shard:{pidb.hex()[:8]}:{idx}")
+
+        async def one_primary(idx: int, holder: bytes, size: int):
+            primary = submit_one(idx, holder, size)
+            hedge_after = max(
+                0.05, float(defaults.RESTORE_HEDGE_DEADLINE_FRACTION)
+                * adaptive_deadline(size, self._peer_throughput(holder)))
+
+            def spawn_hedge():
+                nxt = next(spare_iter, None)
+                if nxt is None:
+                    return None
+                s_idx, (s_holder, s_size) = nxt
+                return submit_one(s_idx, s_holder, s_size)
+
+            return await sched.pull_hedged(primary, spawn_hedge,
+                                           hedge_after)
+
+        results = await asyncio.gather(
+            *(one_primary(idx, holder, size)
+              for idx, (holder, size) in primaries),
+            return_exceptions=True)
+        for res in results:
+            if isinstance(res, BaseException):
+                self._log(f"stripe {pidb.hex()[:8]} pull error: {res}")
+            elif res is not None and res.ok:
+                delivered.append(res.peer_id)
+        # re-queue the shortfall behind the remaining (healthier-ranked)
+        # spares, one at a time — failures here are cheap and bounded
+        while len(delivered) < k:
+            nxt = next(spare_iter, None)
+            if nxt is None:
+                break
+            s_idx, (s_holder, s_size) = nxt
+            res = await submit_one(s_idx, s_holder, s_size)
+            if res.ok:
+                delivered.append(res.peer_id)
+        if delivered:
+            RESTORE_SOURCES.observe(len(set(delivered)))
+        if len(delivered) < k:
+            self._log(f"stripe {pidb.hex()[:8]}: only {len(delivered)}/{k}"
+                      " shard(s) pulled; relying on fallback coverage")
+        return len(delivered)
+
+    async def _pull_striped_restore(self, stripes: Dict, whole: Dict,
+                                    writer: RestoreFilesWriter) -> None:
+        """Execute the pull plan through one unified scheduler: stripe
+        pulls, whole-copy batch pulls, and an index sweep (index files
+        have no placement rows, so every distinct holder is asked once
+        for everything it has)."""
+        sched = TransferScheduler(messenger=self.messenger,
+                                  peer_stats=self.peer_stats)
+        tasks = []
+        for peer, pids in sorted(whole.items()):
+            wants = [(wire.FileInfoKind.PACKFILE, pid)
+                     for pid in sorted(pids)]
+            size = sum(pids.values())
+            tasks.append(sched.submit_pull(
+                peer, size, self._fetch_job(peer, wants, writer, size),
+                label=f"restore:whole:{peer.hex()[:8]}"))
+        holders = {h for m in stripes.values() for h, _s in m.values()}
+        for peer in sorted(set(whole) | holders):
+            tasks.append(sched.submit_pull(
+                peer, 0,
+                self._fetch_job(peer, [(wire.FileInfoKind.INDEX, b"")],
+                                writer, 0),
+                label=f"restore:index:{peer.hex()[:8]}"))
+        stripe_tasks = [
+            asyncio.ensure_future(
+                self._pull_stripe(pidb, shard_map, writer, sched))
+            for pidb, shard_map in sorted(stripes.items())]
+        await asyncio.gather(*tasks, *stripe_tasks, return_exceptions=True)
+        self._log(
+            f"restore pull plan done: {len(stripes)} stripe(s),"
+            f" {len(whole)} whole-copy peer(s),"
+            f" {sched.bytes_pulled} byte(s) pulled")
+
+    async def _pull_restore_all(self, peers: list,
+                                writer: RestoreFilesWriter) -> set:
+        """Legacy full-stream fan-out (RESTORE_ALL): every peer pushes
+        everything it holds for us.  Returns the peers whose stream
+        completed."""
+        streamed: set = set()
+
+        async def pull(peer_id: bytes) -> None:
+            t = await self.node.connect(peer_id,
+                                        wire.RequestType.RESTORE_ALL,
+                                        timeout=self._dial_budget(peer_id))
+            try:
+                await Receiver(t, writer.sink,
+                               part_sink=writer.sink_part,
+                               resume_query=writer.resume_offer).run()
+            finally:
+                await t.close()
+            streamed.add(peer_id)
+            self._log(f"peer {peer_id.hex()[:8]} restore stream complete")
+
+        results = await asyncio.gather(*(pull(p) for p in peers),
+                                       return_exceptions=True)
+        for peer_id, res in zip(peers, results):
+            if isinstance(res, BaseException):
+                self._log(f"restore from {peer_id.hex()[:8]} failed: {res}")
+        return streamed
 
     async def _assemble_restored_stripes(self) -> None:
         """Rebuild packfiles from erasure shards in the restore staging

@@ -800,6 +800,7 @@ class P2PNode:
         self._finalize_waiters: Dict[bytes, asyncio.Queue] = {}
         self.on_transport_request: Optional[Callable] = None
         self.on_restore_request: Optional[Callable] = None
+        self.on_restore_fetch_request: Optional[Callable] = None
         self.on_audit_request: Optional[Callable] = None
         server_client.on_incoming_p2p = self._handle_incoming
         server_client.on_finalize_p2p = self._handle_finalize
@@ -904,6 +905,9 @@ class P2PNode:
                 elif request_type == wire.RequestType.RESTORE_ALL:
                     if self.on_restore_request is not None:
                         await self.on_restore_request(source, transport)
+                elif request_type == wire.RequestType.RESTORE_FETCH:
+                    if self.on_restore_fetch_request is not None:
+                        await self.on_restore_fetch_request(source, transport)
                 elif request_type == wire.RequestType.AUDIT:
                     if self.on_audit_request is not None:
                         await self.on_audit_request(source, transport)
@@ -929,6 +933,73 @@ class P2PNode:
             # instead of restarting (the puller passes a part-capable sink)
             await transport.send_file(data, kind, file_id)
             sent += 1
+        return sent
+
+    # --- shard-granular pull restore (docs/transfer.md restore data plane) --
+
+    async def request_fetch(self, transport: Transport, wants) -> None:
+        """Puller side: name the stored items wanted back on a
+        RESTORE_FETCH connection.  ``wants`` is an iterable of
+        ``(FileInfoKind, file_id)`` pairs; an INDEX want with an empty id
+        asks for every index file the serving peer holds for us (the
+        puller has no placement record of where its index files landed).
+        Correlation is by connection, not sequence, so seq 0 is fine."""
+        body = wire.P2PBody(
+            kind=wire.P2PBodyKind.FETCH_REQUEST,
+            header=wire.P2PHeader(sequence_number=0,
+                                  session_nonce=transport.session_nonce),
+            wants=tuple((wire.FileInfoKind(k), bytes(i))
+                        for k, i in wants))
+        await transport.send_body(body)
+
+    async def serve_restore_fetch(self, peer_id: bytes,
+                                  transport: Transport) -> int:
+        """Serve one FETCH_REQUEST: stream exactly the named items back
+        (skipping ones we don't hold — the puller notices the gap and
+        re-queues on another holder).  Much lighter throttle than
+        ``serve_restore``: a multi-source restore legitimately fans one
+        client across many holders and hedges may revisit us."""
+        peer_hex = bytes(peer_id).hex()
+        last = self.store.last_event_time(f"restore_fetch_served:{peer_hex}")
+        if last is not None and \
+                time.time() - last < defaults.RESTORE_FETCH_MIN_INTERVAL_S:
+            raise P2PError("restore fetch throttled")
+        self.store.add_event(f"restore_fetch_served:{peer_hex}", {})
+        writer = ReceivedFilesWriter(self.store, peer_id)
+        body = await transport.recv_body(defaults.AUDIT_PROOF_TIMEOUT_S)
+        if body.kind != wire.P2PBodyKind.FETCH_REQUEST:
+            raise P2PError(
+                "expected a FETCH_REQUEST body on a restore-fetch"
+                " connection")
+        if len(body.wants) > defaults.RESTORE_FETCH_MAX_WANTS:
+            raise P2PError("too many items in one fetch request")
+        loop = asyncio.get_running_loop()
+
+        def _read(path: Path) -> bytes:
+            return obfuscate(path.read_bytes(), writer.key)
+
+        sent = 0
+        with obs_trace.bind(getattr(body, "trace_id", None)), \
+                obs_trace.span("restore.serve_fetch"):
+            for kind, fid in body.wants:
+                if kind == wire.FileInfoKind.INDEX and not fid:
+                    d = writer.dir / "index"
+                    names = sorted(
+                        f.name for f in d.iterdir()) if d.is_dir() else []
+                    for name in names:
+                        data = await loop.run_in_executor(
+                            None, _read, d / name)
+                        await transport.send_file(
+                            data, wire.FileInfoKind.INDEX,
+                            bytes.fromhex(name))
+                        sent += 1
+                    continue
+                path = writer._dest(kind, fid)
+                if not path.exists():
+                    continue
+                data = await loop.run_in_executor(None, _read, path)
+                await transport.send_file(data, kind, bytes(fid))
+                sent += 1
         return sent
 
     # --- audit serving (prover side of the storage attestation) ------------

@@ -26,6 +26,11 @@ through a scripted sequence of timed phases:
                EngineError until everything completes
 ``restore``    restore to a fresh directory and verify byte-for-byte
                against the source tree digest
+``restore_hedged``  a restore with one measured-fast holder stalled:
+               every frame toward it sleeps past the hedge deadline, so
+               the download lanes must race redundant shards from the
+               spare holders and win
+               (``bkw_restore_hedges_total{outcome=won}``)
 ``wan``        WAN-grade transfer conditions: chunked sends with armed
                mid-transfer cuts that force byte-range resumes, peer
                stats seeded so capacity-aware placement avoids the
@@ -64,6 +69,7 @@ from ..net.server import CoordinationServer
 from ..obs import invariants as obs_invariants
 from ..obs import metrics as obs_metrics
 from ..ops.backend import ChunkerBackend, CpuBackend
+from ..net.peer_stats import PeerEstimate
 from ..ops.gear import CDCParams
 from ..store import PeerStatsRow
 from ..utils import faults
@@ -467,6 +473,46 @@ class ScenarioHarness:
         else:
             self.facts["restore_verified"] &= ok
 
+    async def _phase_restore_hedged(self, ph: Phase) -> None:
+        """Restore with one holder stalled mid-stripe.  The victim is
+        seeded as the fastest measured holder, so the restore planner
+        must pick it as a primary source for every stripe it touches;
+        an armed fault-plane latency then makes every frame the client
+        sends toward it (the FETCH_REQUEST, the acks) sleep past the
+        hedge deadline.  The download lanes must notice the stall, race
+        a redundant shard from a spare holder, and win — the
+        ``bkw_restore_hedges_total{outcome=won}`` gate's evidence —
+        while the restore still verifies byte-for-byte."""
+        placed = sorted({peer for _, peer, _size, idx, _ in
+                         self.a.store.all_placements() if idx >= 0})
+        if not placed:
+            raise ScenarioError("no striped placements to stall")
+        now = time.time()
+        victim = placed[0]
+        ps = self.a.engine.peer_stats
+        for peer in placed:
+            bps = 80e6 if peer == victim else 20e6
+            # the live estimator bank only reads store rows at startup,
+            # so seed both: the row (persistence) and the bank (ranking)
+            self.a.store.put_peer_stats(PeerStatsRow(
+                bytes(peer), bps, 0.01, 1.0, 10, now))
+            with ps._lock:
+                ps._est[bytes(peer)] = PeerEstimate(
+                    peer=bytes(peer), throughput_bps=bps, latency_s=0.01,
+                    success=1.0, samples=10, updated=now)
+        site = f"send.latency:{bytes(victim).hex()}"
+        saved = (self.plane.latency, self.plane.latency_s)
+        # rate epsilon keeps every other latency site quiet while the
+        # armed indices fire unconditionally on the victim's stream
+        self.plane.latency = 1e-12
+        self.plane.latency_s = 2.0
+        self.plane.arm(site, *range(4096))
+        try:
+            await self._phase_restore(ph)
+        finally:
+            self.plane.latency, self.plane.latency_s = saved
+            self.plane._armed.pop(site, None)
+
     async def _phase_wan(self, ph: Phase) -> None:
         """WAN conditions over the chunked transfer plane.  Peer stats
         are seeded so one holder measures slow/flaky and starts
@@ -587,10 +633,25 @@ class ScenarioHarness:
         out.append(A("backups_completed",
                      facts["backups"] >= want_backups,
                      f"{facts['backups']}/{want_backups}"))
-        if any(p.kind == "restore" for p in spec.phases):
+        restore_kinds = ("restore", "restore_hedged")
+        if any(p.kind in restore_kinds for p in spec.phases):
             out.append(A("restore_verified",
                          facts["restore_verified"] is True,
                          "byte-for-byte vs source digest"))
+        if any(p.kind in restore_kinds + ("race",) for p in spec.phases):
+            # the restore data plane must actually pull: a zero delta
+            # means every stripe silently fell back to the legacy
+            # RESTORE_ALL stream (PR 11)
+            pulled = sum(
+                v for k, v in counters.items()
+                if k.startswith("bkw_restore_bytes_pulled_total"))
+            out.append(A("restore_telemetry_flowing", pulled > 0,
+                         f"bytes_pulled={pulled:g}"))
+        if any(p.kind == "restore_hedged" for p in spec.phases):
+            won = counters.get(
+                "bkw_restore_hedges_total{outcome=won}", 0)
+            out.append(A("hedge_recovered_stall", won >= 1,
+                         f"hedges_won={won:g}"))
         violation_s = sum(
             v for k, v in counters.items()
             if k.startswith("bkw_durability_violation_seconds_total"))
@@ -727,7 +788,7 @@ def builtin_scenarios() -> Dict[str, ScenarioSpec]:
                     P("byzantine"),
                     P("repair"),
                     P("race", grow=True),
-                    P("restore"))),
+                    P("restore_hedged"))),
         "wan": ScenarioSpec(
             name="wan", seed=71, corpus_files=4, chunk_bytes=4096,
             phases=(P("wan"), P("restore"))),

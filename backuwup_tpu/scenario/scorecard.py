@@ -78,6 +78,11 @@ COUNTER_FAMILIES = (
     "bkw_matchmaking_expired_total",
     "bkw_server_requests_total",
     "bkw_server_store_commits_total",
+    # restore data plane (PR 11): shard-granular pull traffic per source
+    # peer and the hedging policy's win/loss record — the restore
+    # telemetry gate's evidence
+    "bkw_restore_bytes_pulled_total",
+    "bkw_restore_hedges_total",
 )
 
 #: Histogram families quantiled in the card.
@@ -93,6 +98,9 @@ HISTOGRAM_FAMILIES = (
     "bkw_server_request_seconds",
     "bkw_loop_stall_seconds",
     "bkw_server_store_batch_ops",
+    # restore data plane (PR 11): how many distinct holders each stripe
+    # actually drew from
+    "bkw_restore_sources_per_stripe",
 )
 
 

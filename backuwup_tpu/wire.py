@@ -458,11 +458,14 @@ class AuditDue(JsonMessage):
 # --- p2p data-plane messages (reference shared/src/p2p_message.rs) ----------
 
 class RequestType(IntEnum):
-    """p2p_message.rs:36-39 (AUDIT added for storage attestation)."""
+    """p2p_message.rs:36-39 (AUDIT added for storage attestation,
+    RESTORE_FETCH for shard-granular pull restore — docs/transfer.md
+    restore data plane)."""
 
     TRANSPORT = 0
     RESTORE_ALL = 1
     AUDIT = 2
+    RESTORE_FETCH = 3
 
 
 class FileInfoKind(IntEnum):
@@ -504,6 +507,10 @@ class P2PBodyKind(IntEnum):
     FILE_PART = 5  # one byte range of a file, acked like FILE
     RESUME_QUERY = 6  # sender asks: how much of file_id do you hold?
     RESUME_OFFER = 7  # receiver's answer, echoing the query's sequence
+    # shard-granular pull restore (docs/transfer.md restore data plane).
+    # Additive like the resume trio: only sent on RESTORE_FETCH sessions,
+    # which old peers never accept, so RESTORE_ALL interop is untouched.
+    FETCH_REQUEST = 8  # puller names the stored items it wants
 
 
 class ProofStatus(IntEnum):
@@ -587,6 +594,7 @@ class P2PBody:
     total_size: int = 0  # FILE_PART: whole-file length
     file_digest: bytes = b""  # FILE_PART / RESUME_OFFER: whole-file blake3
     prefix_digest: bytes = b""  # RESUME_OFFER: blake3 of the held prefix
+    wants: tuple = ()  # FETCH_REQUEST: (FileInfoKind, file_id) pairs
 
     def encode_bytes(self) -> bytes:
         w = Writer()
@@ -624,6 +632,11 @@ class P2PBody:
             # both digests are empty blobs when nothing is held
             w.blob(self.file_digest)
             w.blob(self.prefix_digest)
+        elif self.kind == P2PBodyKind.FETCH_REQUEST:
+            w.u64(len(self.wants))
+            for fi, fid in self.wants:
+                w.u32(int(fi))
+                w.blob(fid)
         return w.take()
 
     @classmethod
@@ -661,6 +674,9 @@ class P2PBody:
             kw["offset"] = r.u64()
             kw["file_digest"] = r.blob()
             kw["prefix_digest"] = r.blob()
+        elif kind == P2PBodyKind.FETCH_REQUEST:
+            kw["wants"] = tuple(
+                (FileInfoKind(r.u32()), r.blob()) for _ in range(r.u64()))
         r.expect_end()
         return cls(kind=kind, header=header, **kw)
 

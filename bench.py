@@ -241,6 +241,13 @@ def main() -> None:
     if "matchmakings_per_s" in swarm:
         record["matchmakings_per_s"] = swarm["matchmakings_per_s"]
         record["server_p99_ms"] = swarm.get("server_p99_ms")
+    # config #13 measures serial-vs-multi-source restore in one run;
+    # surface both acceptance numbers (wall speedup, bytes-on-wire
+    # ratio) at top level so BENCH_r*.json diffs track them directly
+    restore = configs.get("13_restore", {})
+    if "speedup" in restore:
+        record["restore_speedup"] = restore["speedup"]
+        record["restore_bytes_ratio"] = restore.get("bytes_ratio")
     print(json.dumps({
         **record,
         "note": "corpus synthesized on-device (host<->device relay tunnel "

@@ -988,6 +988,210 @@ def config12_swarm(log: Callable) -> Dict:
             "scorecard": card.to_dict()}
 
 
+def config13_restore(log: Callable) -> Dict:
+    """Serial all-holder RESTORE_ALL vs multi-source k-of-n restore — #13.
+
+    One loopback deployment (CoordinationServer, one source, N holders),
+    one striped backup, then the SAME restore twice into different
+    destinations, both legs in one record:
+
+      serial — the pre-pull-plane shape: the placement map is ignored
+               (``_restore_plan`` forced to None) so every holder pushes
+               its entire stream and the wall clock waits out the
+               slowest; one holder's frames are armed with a per-send
+               stall through the fault plane, the WAN shape where one
+               seeder crawls
+      multi  — the shard-granular pull planner: each stripe from its k
+               fastest holders by the peer-stats estimators (the crawler
+               is measured-slow, so it is a spare, not a primary), with
+               a second holder killed dark between the legs so its
+               re-queued pulls must land on healthier peers
+
+    ``speedup`` is serial/multi wall (gate >= 2x), ``bytes_ratio`` is
+    multi/serial sender-side bytes-on-wire (the bkw_p2p_bytes_sent_total
+    delta; k/n = 4/6 floor ~= 0.67, gate <= 0.8).  Ratio measurement,
+    one pass each — not a sustained-window config.
+    """
+    import asyncio
+    import contextlib
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from backuwup_tpu import defaults
+    from backuwup_tpu.app import ClientApp
+    from backuwup_tpu.net.peer_stats import PeerEstimate
+    from backuwup_tpu.net.server import CoordinationServer
+    from backuwup_tpu.obs import metrics as obs_metrics
+    from backuwup_tpu.ops.backend import CpuBackend, NativeBackend
+    from backuwup_tpu.utils import faults
+
+    total_mib = int(os.environ.get("BENCH_C13_MIB", "2"))
+    n_peers = int(os.environ.get("BENCH_C13_PEERS", "6"))
+    latency_s = float(os.environ.get("BENCH_C13_LATENCY_S", "0.4"))
+
+    saved = {k: getattr(defaults, k) for k in (
+        "PACKFILE_TARGET_SIZE", "RESTORE_REQUEST_THROTTLE_S")}
+    tmp = Path(tempfile.mkdtemp(prefix="bkw_bench_c13_"))
+    rng = np.random.default_rng(131)
+    src = tmp / "src"
+    src.mkdir()
+    written = 0
+    i = 0
+    while written < (total_mib << 20):
+        sub = src / f"d{i % 8}"
+        sub.mkdir(exist_ok=True)
+        n = int(rng.integers(64 << 10, 256 << 10))
+        (sub / f"f{i}").write_bytes(rng.bytes(n))
+        written += n
+        i += 1
+
+    def wire_bytes() -> float:
+        fam = obs_metrics.registry().snapshot().get(
+            "bkw_p2p_bytes_sent_total") or {}
+        return sum(s["value"] for s in fam.get("series", []))
+
+    def tree_bytes(root: Path) -> int:
+        return sum(p.stat().st_size for p in root.rglob("*") if p.is_file())
+
+    async def both() -> Dict:
+        plane = faults.install(faults.FaultPlane(seed=131))
+        server = CoordinationServer(db_path=str(tmp / "server.db"))
+        port = await server.start()
+
+        def make_app(name):
+            # native chunk+hash where available: the measurement is the
+            # restore data plane, not the python oracle chunker
+            params = CDCParams.from_desired(16 << 10)
+            try:
+                backend = NativeBackend(params)
+            except Exception:
+                backend = CpuBackend(params)
+            app = ClientApp(config_dir=tmp / name / "cfg",
+                            data_dir=tmp / name / "data",
+                            server_addr=f"127.0.0.1:{port}",
+                            backend=backend,
+                            tls=False)  # plaintext loopback deployment
+            return app
+
+        a = make_app("a")
+        a.store.set_backup_path(str(src))
+        holders = [make_app(f"p{j}") for j in range(n_peers)]
+        apps = [a] + holders
+        try:
+            for app in apps:
+                await app.start()
+                app._audit_task.cancel()
+            a.engine.auto_repair = False
+            amt = 8 * (written + (64 << 20)) // max(1, n_peers)
+            for peer in holders:
+                a.store.add_peer_negotiated(peer.client_id, amt)
+                peer.store.add_peer_negotiated(a.client_id, amt)
+                server.db.save_storage_negotiated(
+                    bytes(a.client_id), bytes(peer.client_id), amt)
+            snapshot = await asyncio.wait_for(a.backup(), 600)
+            if not snapshot:
+                raise RuntimeError("config #13: backup returned none")
+            placed = sorted({bytes(peer) for _, peer, _s, idx, _ in
+                             a.store.all_placements() if idx >= 0})
+            if len(placed) < 3:
+                raise RuntimeError(
+                    f"config #13: only {len(placed)} striped holders")
+            slow, dark = placed[0], placed[1]
+            # seed the live estimator bank (ranking reads memory, not the
+            # store): the crawling holder is measured-slow so the planner
+            # leaves it as a spare; the soon-to-be-dark holder ranks
+            # fastest so its failed pulls must re-queue onto the rest
+            ps = a.engine.peer_stats
+            with ps._lock:
+                for j, peer in enumerate(placed):
+                    bps = {slow: 1e3, dark: 100e6}.get(peer, (50 + j) * 1e6)
+                    ps._est[peer] = PeerEstimate(
+                        peer=peer, throughput_bps=bps, latency_s=0.01,
+                        success=1.0, samples=10, updated=time.time())
+            # slow-seeder injection: pace every file the slow holder
+            # serves (both protocols — the holder is slow, period; the
+            # multi leg wins by ROUTING around it, not by a kinder fault)
+            slow_app = next(h for h in holders
+                            if bytes(h.client_id) == slow)
+
+            def paced(serve):
+                async def run(peer_id, transport):
+                    real = transport.send_file
+
+                    async def crawl(*args, **kw):
+                        await asyncio.sleep(latency_s)
+                        return await real(*args, **kw)
+                    transport.send_file = crawl
+                    return await serve(peer_id, transport)
+                return run
+
+            slow_app.node.serve_restore = paced(
+                slow_app.node.serve_restore)
+            slow_app.node.serve_restore_fetch = paced(
+                slow_app.node.serve_restore_fetch)
+
+            async def one_restore(tag: str) -> Dict:
+                before, t0 = wire_bytes(), time.time()
+                out = await asyncio.wait_for(
+                    a.restore(dest=tmp / f"out_{tag}"), 600)
+                wall = time.time() - t0
+                if tree_bytes(Path(out)) != written:
+                    raise RuntimeError(
+                        f"config #13 {tag}: restored size mismatch")
+                return {"bytes_wire": round(wire_bytes() - before),
+                        "wall_s": round(wall, 3)}
+
+            legs = {}
+            a.engine._restore_plan = lambda: None  # force legacy streams
+            try:
+                legs["serial"] = await one_restore("serial")
+            finally:
+                del a.engine._restore_plan
+            plane.kill(dark)  # holder goes dark between the legs
+            legs["multi"] = await one_restore("multi")
+            legs["slow"], legs["dark"] = slow.hex()[:16], dark.hex()[:16]
+            return legs
+        finally:
+            for app in apps:
+                with contextlib.suppress(Exception):
+                    await app.stop()
+            await server.stop()
+            faults.uninstall()
+
+    try:
+        defaults.PACKFILE_TARGET_SIZE = 128 * 1024
+        defaults.RESTORE_REQUEST_THROTTLE_S = 0.0
+        legs = asyncio.run(both())
+        data_mib = written / (1 << 20)
+        speedup = legs["serial"]["wall_s"] / legs["multi"]["wall_s"]
+        ratio = legs["multi"]["bytes_wire"] / max(
+            legs["serial"]["bytes_wire"], 1)
+        passed = speedup >= 2.0 and ratio <= 0.8
+        log(f"config#13 restore: {data_mib:.0f} MiB from {n_peers} holders "
+            f"(+{latency_s * 1000:.0f}ms/frame to one): serial "
+            f"{legs['serial']['wall_s']}s / multi {legs['multi']['wall_s']}s"
+            f" = {speedup:.2f}x, bytes {ratio:.2f}x "
+            f"[{'PASS' if passed else 'FAIL'}]")
+        return {"mib_s": round(data_mib / legs["multi"]["wall_s"], 2),
+                "serial_mib_s": round(data_mib / legs["serial"]["wall_s"],
+                                      2),
+                "speedup": round(speedup, 2),
+                "bytes_ratio": round(ratio, 3),
+                "passed": passed,
+                "serial": legs["serial"], "multi": legs["multi"],
+                "slow_holder": legs["slow"], "dark_holder": legs["dark"],
+                "peers": n_peers,
+                "latency_ms": round(latency_s * 1000, 1),
+                "data_mib": round(data_mib, 2),
+                "wall_s": round(legs["serial"]["wall_s"]
+                                + legs["multi"]["wall_s"], 2)}
+    finally:
+        for k, v in saved.items():
+            setattr(defaults, k, v)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -1004,7 +1208,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("9_scenario", lambda: config9_scenario(log)),
             ("10_wan", lambda: config10_wan(log)),
             ("11_crash", lambda: config11_crash(log)),
-            ("12_swarm", lambda: config12_swarm(log))):
+            ("12_swarm", lambda: config12_swarm(log)),
+            ("13_restore", lambda: config13_restore(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

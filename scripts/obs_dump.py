@@ -152,6 +152,41 @@ def _peer_lines(samples: dict) -> "list[str]":
     return lines
 
 
+_RESTORE_PULL_RE = re.compile(
+    r'^bkw_restore_bytes_pulled_total\{peer="(?P<peer>[^"]*)"\} $')
+_RESTORE_HEDGE_RE = re.compile(
+    r'^bkw_restore_hedges_total\{outcome="(?P<outcome>[^"]*)"\} $')
+
+
+def _restore_lines(samples: dict) -> "list[str]":
+    """One summary line for the restore data plane (net/transfer.py
+    download lanes): bytes pulled per source peer and the hedging
+    policy's win/loss record."""
+    pulled: dict = {}
+    hedges: dict = {}
+    for key, value in samples.items():
+        m = _RESTORE_PULL_RE.match(key + " ")
+        if m:
+            pulled[m.group("peer")] = value
+            continue
+        m = _RESTORE_HEDGE_RE.match(key + " ")
+        if m:
+            hedges[m.group("outcome")] = value
+    lines = []
+    if pulled:
+        total = sum(pulled.values())
+        top = max(pulled, key=pulled.get)
+        lines.append(
+            f"~ restore pulled_MiB={total / (1 << 20):.6g} "
+            f"sources={len(pulled)} top={top} "
+            f"top_MiB={pulled[top] / (1 << 20):.6g}")
+    if hedges:
+        parts = " ".join(f"{k}={hedges[k]:g}"
+                         for k in ("won", "lost", "wasted") if k in hedges)
+        lines.append(f"~ restore hedges {parts}")
+    return lines
+
+
 def _print_view(samples: dict, prev=None) -> None:
     """Non-zero samples (first poll) or changed-with-delta (re-polls),
     then the histogram quantile and per-peer estimator summary lines."""
@@ -168,6 +203,8 @@ def _print_view(samples: dict, prev=None) -> None:
     for line in _histogram_quantiles(samples, prev=prev):
         print(line)
     for line in _peer_lines(samples):
+        print(line)
+    for line in _restore_lines(samples):
         print(line)
 
 

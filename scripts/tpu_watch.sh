@@ -101,6 +101,19 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "swarm bench recapture FAILED (see $swm) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated restore recapture: config #13 alone (host-only
+        # loopback p2p, serial RESTORE_ALL vs multi-source k-of-n pulls
+        # under one slow and one dark holder) — the restore_speedup and
+        # restore_bytes_ratio numbers survive even when the device suite
+        # timed out partway
+        rst="$BENCH_OUT_DIR/BENCH_restore_${stamp}.json"
+        if timeout "${BENCH_RESTORE_TIMEOUT_S:-600}" \
+                env BENCH_ONLY_CONFIG=13_restore BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$rst" 2>>/tmp/tpu_watch.log; then
+            echo "restore bench recaptured to $rst at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "restore bench recapture FAILED (see $rst) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

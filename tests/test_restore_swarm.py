@@ -1,0 +1,308 @@
+"""Swarm restore data plane (PR 11): k-of-n multi-source pulls.
+
+Unit coverage of the planner (k fastest holders become primaries under
+the peer-stats estimators), the scheduler's download lanes (hedged
+pulls, stalled-transfer re-queue onto a different peer), plus loopback
+e2e proofs: a dark holder mid-restore costs nothing, a slow holder is
+hedged around, and a peer speaking only the legacy RESTORE_ALL protocol
+still restores byte-for-byte through the fallback path.
+"""
+
+import asyncio
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from backuwup_tpu import defaults
+from backuwup_tpu.crypto import KeyManager
+from backuwup_tpu.engine import Engine
+from backuwup_tpu.net.p2p import P2PError, RestoreFilesWriter
+from backuwup_tpu.net.peer_stats import PeerEstimate
+from backuwup_tpu.net.transfer import TransferScheduler
+from backuwup_tpu.obs import journal as obs_journal
+from backuwup_tpu.obs import metrics as obs_metrics
+from backuwup_tpu.ops.backend import CpuBackend
+from backuwup_tpu.ops.gear import CDCParams
+from backuwup_tpu.scenario import Phase, ScenarioHarness, ScenarioSpec
+from backuwup_tpu.store import Store
+
+pytestmark = pytest.mark.concurrency
+
+
+def _run(coro, timeout=120):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+def _fam_total(name: str, **labels) -> float:
+    fam = obs_metrics.registry().snapshot().get(name) or {}
+    total = 0.0
+    for s in fam.get("series", []):
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            total += s["value"]
+    return total
+
+
+@pytest.fixture
+def engine(tmp_path):
+    keys = KeyManager.generate()
+    store = Store(directory=tmp_path / "cfg", data_base=tmp_path / "data")
+    eng = Engine(keys, store, server=None, node=None,
+                 backend=CpuBackend(CDCParams.from_desired(4096)))
+    yield eng
+    store.close()
+
+
+def _seed_estimate(eng, peer: bytes, bps: float, samples: int = 10):
+    with eng.peer_stats._lock:
+        eng.peer_stats._est[bytes(peer)] = PeerEstimate(
+            peer=bytes(peer), throughput_bps=bps, latency_s=0.01,
+            success=1.0, samples=samples, updated=time.time())
+
+
+# --- planner: source selection ----------------------------------------------
+
+def test_planner_pulls_from_the_k_fastest_holders(engine):
+    """6 holders with seeded estimator rates: only the RS_K fastest are
+    submitted as primaries; the slow tail stays in reserve as spares."""
+    pid = b"\x61" * 12
+    holders = [bytes([0x70 + i]) * 32 for i in range(6)]
+    # ranks: holder i measures (i+1)*1e6 B/s -> fastest are the last 4
+    for i, h in enumerate(holders):
+        _seed_estimate(engine, h, (i + 1) * 1e6)
+    shard_map = {i: (h, 4096) for i, h in enumerate(holders)}
+    writer = RestoreFilesWriter(engine.store)
+
+    class FakeSched:
+        def __init__(self):
+            self.submitted = []
+
+        def submit_pull(self, peer, size, job, label=""):
+            self.submitted.append(bytes(peer))
+
+            async def done():
+                return SimpleNamespace(ok=True, peer_id=bytes(peer))
+            return asyncio.ensure_future(done())
+
+        async def pull_hedged(self, primary, spawn_hedge, hedge_after_s):
+            return await primary
+
+    async def go():
+        sched = FakeSched()
+        got = await engine._pull_stripe(pid, shard_map, writer, sched)
+        return sched, got
+
+    sched, got = _run(go())
+    assert got == defaults.RS_K
+    assert len(sched.submitted) == defaults.RS_K
+    # exactly the 4 fastest (holders 2..5), none of the slow tail
+    assert set(sched.submitted) == set(holders[-defaults.RS_K:])
+
+
+def test_unmeasured_holder_scores_neutral(engine):
+    """Below PLACEMENT_MIN_SAMPLES the estimator says nothing: the rate
+    is the neutral placement score, not zero — a cold holder is neither
+    first pick nor untouchable."""
+    cold, slow = b"\x01" * 32, b"\x02" * 32
+    _seed_estimate(engine, cold, 99e6, samples=1)  # too few samples
+    _seed_estimate(engine, slow, 1e3)
+    assert engine._pull_rate(cold) == float(
+        defaults.PLACEMENT_NEUTRAL_SCORE_BPS)
+    assert engine._pull_rate(slow) < engine._pull_rate(cold)
+
+
+# --- scheduler: hedged pulls and re-queue ------------------------------------
+
+def test_hedge_fires_on_stall_and_redundant_shard_wins():
+    """A primary pull stalled past the hedge deadline races a spare; the
+    spare delivers and the outcome counts as won."""
+    won0 = _fam_total("bkw_restore_hedges_total", outcome="won")
+
+    async def go():
+        sched = TransferScheduler()
+        stalled, hedged = b"\x0a" * 32, b"\x0b" * 32
+
+        async def stall():
+            await asyncio.sleep(30)
+            return 10
+
+        async def quick():
+            return 10
+
+        primary = sched.submit_pull(stalled, 10, stall, label="r:p")
+
+        def spawn_hedge():
+            return sched.submit_pull(hedged, 10, quick, label="r:h")
+
+        res = await sched.pull_hedged(primary, spawn_hedge, 0.05)
+        return res, hedged
+
+    res, hedged = _run(go())
+    assert res is not None and res.ok
+    assert bytes(res.peer_id) == hedged
+    assert _fam_total("bkw_restore_hedges_total", outcome="won") == won0 + 1
+
+
+def test_primary_recovery_counts_hedge_as_lost():
+    """The hedge launches but the lagging primary finishes first: its
+    result is used and the hedge is accounted lost, not won."""
+    lost0 = _fam_total("bkw_restore_hedges_total", outcome="lost")
+
+    async def go():
+        sched = TransferScheduler()
+        lagging, spare = b"\x0c" * 32, b"\x0d" * 32
+
+        async def lag():
+            await asyncio.sleep(0.2)
+            return 10
+
+        async def very_slow():
+            await asyncio.sleep(30)
+            return 10
+
+        primary = sched.submit_pull(lagging, 10, lag, label="r:p")
+
+        def spawn_hedge():
+            return sched.submit_pull(spare, 10, very_slow, label="r:h")
+
+        res = await sched.pull_hedged(primary, spawn_hedge, 0.05)
+        return res, lagging
+
+    res, lagging = _run(go())
+    assert res is not None and res.ok
+    assert bytes(res.peer_id) == lagging
+    assert _fam_total("bkw_restore_hedges_total",
+                      outcome="lost") == lost0 + 1
+
+
+def test_requeued_download_lands_on_a_different_peer():
+    """A failed pull re-queues behind the next-ranked source instead of
+    hammering the same peer."""
+
+    async def go():
+        sched = TransferScheduler()
+        bad, good = b"\x0e" * 32, b"\x0f" * 32
+        attempts = []
+
+        def make_pull(peer):
+            async def job():
+                attempts.append(bytes(peer))
+                if bytes(peer) == bad:
+                    raise P2PError("injected stall")
+                return 7
+            return job
+
+        res = await sched.pull_with_requeue([bad, good], 7, make_pull,
+                                            label="r:q")
+        return res, attempts, bad, good
+
+    res, attempts, bad, good = _run(go())
+    assert res is not None and res.ok
+    assert bytes(res.peer_id) == good
+    assert attempts == [bad, good]
+    # the winning result carries no residue of the failed first attempt
+    assert res.error is None
+
+
+# --- loopback e2e ------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """Registry + journal isolation, same posture as test_scenario.py."""
+    obs_metrics.registry().reset()
+    yield
+    obs_metrics.registry().reset()
+    obs_journal.uninstall()
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def _striped_holders(harness):
+    return sorted({peer for _, peer, _s, idx, _ in
+                   harness.a.store.all_placements() if idx >= 0})
+
+
+def test_dark_holder_mid_restore_costs_nothing(tmp_path, loop):
+    """A holder that goes permanently dark between backup and restore
+    contributes zero pulled bytes; the spares cover its stripes and the
+    restore still verifies byte-for-byte."""
+    spec = ScenarioSpec(name="dark", seed=7,
+                        phases=(Phase("backup"), Phase("restore")))
+
+    async def run():
+        h = ScenarioHarness(spec, tmp_path)
+        await h.setup()
+        try:
+            await h._phase_backup(Phase("backup"))
+            victim = _striped_holders(h)[0]
+            h.plane.kill(victim)
+            await h._phase_restore(Phase("restore"))
+            assert h.facts["restore_verified"] is True
+            label = bytes(victim).hex()[:16]
+            assert _fam_total("bkw_restore_bytes_pulled_total",
+                              peer=label) == 0
+            assert _fam_total("bkw_restore_bytes_pulled_total") > 0
+        finally:
+            await h.teardown()
+
+    loop.run_until_complete(run())
+
+
+def test_slow_and_dark_holder_e2e_restores_byte_for_byte(tmp_path, loop):
+    """The acceptance composition: one measured-fast holder stalls every
+    frame (hedged around, outcome won) while another is dark (re-queued
+    around), and the restore still verifies byte-for-byte."""
+    spec = ScenarioSpec(name="slowdark", seed=17, spares=2,
+                        phases=(Phase("backup"), Phase("restore_hedged")))
+
+    async def run():
+        h = ScenarioHarness(spec, tmp_path)
+        await h.setup()
+        try:
+            await h._phase_backup(Phase("backup"))
+            placed = _striped_holders(h)
+            dark = placed[1]  # the hedged phase stalls placed[0]
+            h.plane.kill(dark)
+            await h._phase_restore_hedged(Phase("restore_hedged"))
+            assert h.facts["restore_verified"] is True
+            assert _fam_total("bkw_restore_hedges_total",
+                              outcome="won") >= 1
+            assert _fam_total("bkw_restore_bytes_pulled_total",
+                              peer=bytes(dark).hex()[:16]) == 0
+        finally:
+            await h.teardown()
+
+    loop.run_until_complete(run())
+
+
+def test_legacy_restore_all_only_peers_still_restore(tmp_path, loop):
+    """Interop: holders that predate the shard-granular fetch protocol
+    (RESTORE_FETCH falls on deaf ears) force the coverage-gap fallback
+    to full RESTORE_ALL streams — the restore completes byte-for-byte
+    through the legacy path."""
+    spec = ScenarioSpec(name="legacy", seed=27,
+                        phases=(Phase("backup"), Phase("restore")))
+
+    async def run():
+        h = ScenarioHarness(spec, tmp_path)
+        await h.setup()
+        try:
+            await h._phase_backup(Phase("backup"))
+            for holder in h.holders + h.spares:
+                # an old peer accepts the dial but has no fetch handler
+                holder.node.on_restore_fetch_request = None
+            await h._phase_restore(Phase("restore"))
+            assert h.facts["restore_verified"] is True
+        finally:
+            await h.teardown()
+
+    loop.run_until_complete(run())
