@@ -199,6 +199,11 @@ class Engine:
         if dedup_mesh is not None:
             from .snapshot.device_dedup import MeshDedupIndex
             self.device_dedup = MeshDedupIndex(dedup_mesh, self.index)
+            # the manifest pipeline shards batches over the same mesh so
+            # digests can hand off to the dedup table on device
+            if hasattr(self.backend, "attach_mesh"):
+                self.backend.attach_mesh(dedup_mesh,
+                                         self.device_dedup.axis)
         self.orchestrator = Orchestrator()
         self.last_pack_stats = None
         # backup and restore are mutually exclusive and non-reentrant
@@ -516,8 +521,7 @@ class Engine:
             packer = DirPacker(self.backend, writer, self.index,
                                progress=self._pack_progress,
                                should_pause=orch.block_if_paused,
-                               dedup_batch=(self.device_dedup.classify_insert
-                                            if self.device_dedup else None))
+                               dedup_index=self.device_dedup)
             try:
                 with obs_trace.bind(backup_tid), \
                         tracing.span("engine.pack"), \
@@ -1588,8 +1592,7 @@ class Engine:
             packer = DirPacker(self.backend, writer, self.index,
                                progress=self._pack_progress,
                                should_pause=orch.block_if_paused,
-                               dedup_batch=(self.device_dedup.classify_insert
-                                            if self.device_dedup else None))
+                               dedup_index=self.device_dedup)
             try:
                 with obs_trace.bind(repair_tid), \
                         tracing.span("engine.repair_pack"):

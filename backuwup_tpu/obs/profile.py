@@ -72,6 +72,35 @@ _PROFILE_SECONDS = _metrics.histogram(
     "Honest chained-execution device seconds per profiled stage "
     "(dev_time_stage)", labelnames=("stage",))
 
+# Per-device twins of the dispatch/bytes/pad families for the mesh
+# pipeline (shard_map over the row axis).  Additive alongside the
+# unlabeled families above, PR-7 style (bkw_peer_transfer_* next to
+# bkw_transfer_*): one shard_map launch still counts ONCE per stage in
+# bkw_device_dispatch_total, and additionally once per participating
+# device here — so the unlabeled families keep their hand-countable
+# "one program launch" meaning while these expose the per-shard split.
+_DISPATCH_DEV = _metrics.counter(
+    "bkw_mesh_device_dispatch_total",
+    "Mesh-pipeline dispatches by stage and participating device shard",
+    labelnames=("stage", "device"))
+_STAGE_BYTES_DEV = _metrics.counter(
+    "bkw_mesh_stage_bytes_total",
+    "Actual payload bytes per stage per device shard",
+    labelnames=("stage", "device"))
+_STAGE_PADDED_DEV = _metrics.counter(
+    "bkw_mesh_stage_padded_bytes_total",
+    "Bytes as dispatched per stage per device shard, padding included",
+    labelnames=("stage", "device"))
+_PAD_EFFICIENCY_DEV = _metrics.gauge(
+    "bkw_mesh_pad_efficiency",
+    "Cumulative actual/padded byte ratio per stage per device shard",
+    labelnames=("stage", "device"))
+_HBM_HIGH = _metrics.gauge(
+    "bkw_mesh_hbm_highwater_bytes",
+    "Peak bytes in flight per device across the mesh driver's dispatch "
+    "window (buffers + packed cuts + digest accumulator + dedup lanes)",
+    labelnames=("device",))
+
 # Span names whose bkw_span_seconds sums a pipeline report attributes as
 # per-stage wall time (the device pipeline's dispatch/collect pairs plus
 # the packer entry point that drives them).
@@ -82,6 +111,8 @@ REPORT_SPANS = (
     "pipeline.digest_collect",
     "pipeline.scan_digest_dispatch",
     "pipeline.scan_digest_collect",
+    "pipeline.mesh_dispatch",
+    "pipeline.mesh_collect",
     "packer.manifest_many",
 )
 
@@ -101,6 +132,37 @@ def dispatch(stage: str, count: int = 1, actual_bytes: int = 0,
         if padded > 0:
             _PAD_EFFICIENCY.set(
                 _STAGE_BYTES.value(stage=stage) / padded, stage=stage)
+
+
+def dispatch_device(stage: str, device: int, count: int = 1,
+                    actual_bytes: int = 0, padded_bytes: int = 0) -> None:
+    """Record one device shard's share of a mesh launch.
+
+    Touches ONLY the per-device families — the caller records the launch
+    itself once via :func:`dispatch`, so ``bkw_device_dispatch_total``
+    stays the hand-countable program-launch count and
+    ``bkw_mesh_device_dispatch_total`` sums to launches x mesh size."""
+    if stage not in STAGES:
+        raise ValueError(f"unknown pipeline stage {stage!r}")
+    dev = str(device)
+    _DISPATCH_DEV.inc(count, stage=stage, device=dev)
+    if actual_bytes:
+        _STAGE_BYTES_DEV.inc(actual_bytes, stage=stage, device=dev)
+    if padded_bytes:
+        _STAGE_PADDED_DEV.inc(padded_bytes, stage=stage, device=dev)
+        padded = _STAGE_PADDED_DEV.value(stage=stage, device=dev)
+        if padded > 0:
+            _PAD_EFFICIENCY_DEV.set(
+                _STAGE_BYTES_DEV.value(stage=stage, device=dev) / padded,
+                stage=stage, device=dev)
+
+
+def hbm_high_water(device: int, in_flight_bytes: int) -> None:
+    """Raise (never lower) the per-device HBM high-water gauge."""
+    dev = str(device)
+    cur = _HBM_HIGH.value(device=dev)
+    if in_flight_bytes > cur:
+        _HBM_HIGH.set(in_flight_bytes, device=dev)
 
 
 # --- honest device timing (the scripts/devtime.py technique) ----------------
@@ -148,10 +210,19 @@ def dev_time_stage(stage: str, fn, *args, n: int = 20) -> float:
 
 # --- per-backup pipeline report ---------------------------------------------
 
+def _device_values(fam) -> Dict[tuple, float]:
+    """{(device, stage): value} for one (stage, device)-labeled family."""
+    return {(s["labels"]["device"], s["labels"]["stage"]): s["value"]
+            for s in fam._snapshot_series()}
+
+
 def baseline() -> Dict[str, Dict[str, float]]:
     """Snapshot the profiler families so :func:`report` can attribute a
     delta to one backup (the engine's ``_registry_stage_sums`` idiom)."""
-    out = {"dispatch": {}, "bytes": {}, "padded": {}, "span_s": {}}
+    out = {"dispatch": {}, "bytes": {}, "padded": {}, "span_s": {},
+           "dispatch_dev": _device_values(_DISPATCH_DEV),
+           "bytes_dev": _device_values(_STAGE_BYTES_DEV),
+           "padded_dev": _device_values(_STAGE_PADDED_DEV)}
     for stage in STAGES:
         out["dispatch"][stage] = _DISPATCH.value(stage=stage)
         out["bytes"][stage] = _STAGE_BYTES.value(stage=stage)
@@ -182,13 +253,37 @@ def report(base: Optional[dict] = None) -> dict:
         for stage in STAGES}
     stage_seconds = {name: round(dt, 6)
                      for name, dt in _delta("span_s").items() if dt > 0}
-    return {
+    # per-device split of the mesh-pipeline launches: {device: {stage: n}}
+    # plus per-device pad efficiency, so the report shows whether work
+    # divided evenly across the shards (the bench even-split gate)
+    by_device: Dict[str, Dict[str, int]] = {}
+    eff_device: Dict[str, Dict[str, Optional[float]]] = {}
+    prior_d = base.get("dispatch_dev", {})
+    now_d = now["dispatch_dev"]
+    for (dev, stage), v in now_d.items():
+        n = int(v - prior_d.get((dev, stage), 0.0))
+        if n:
+            by_device.setdefault(dev, {})[stage] = n
+    prior_b, prior_p = base.get("bytes_dev", {}), base.get("padded_dev", {})
+    for (dev, stage), v in now["padded_dev"].items():
+        dp = v - prior_p.get((dev, stage), 0.0)
+        if dp > 0:
+            db = now["bytes_dev"].get((dev, stage), 0.0) \
+                - prior_b.get((dev, stage), 0.0)
+            eff_device.setdefault(dev, {})[stage] = round(db / dp, 6)
+    out = {
         "dispatches": dispatches,
         "bytes": actual,
         "padded_bytes": padded,
         "pad_efficiency": efficiency,
         "stage_seconds": stage_seconds,
     }
+    if by_device:
+        out["device_dispatches"] = {
+            d: by_device[d] for d in sorted(by_device, key=int)}
+        out["device_pad_efficiency"] = {
+            d: eff_device.get(d, {}) for d in sorted(by_device, key=int)}
+    return out
 
 
 def emit_report(rep: dict, **fields) -> None:

@@ -107,6 +107,22 @@ class ChunkerBackend:
     def manifest(self, data) -> List[ChunkRef]:
         return self.manifest_many([data])[0]
 
+    def manifest_many_classified(self, streams: Sequence[bytes], dedup):
+        """Manifest + dedup-classify one batch in a single call.
+
+        Returns ``(manifests, hints)`` where ``hints`` aligns with the
+        flattened refs (row-major over streams) — the packer's dup-hint
+        contract.  Base backends run the two passes back to back against
+        ``dedup.classify_insert``; :class:`TpuBackend` overrides with the
+        mesh pipeline, which hands digests to the sharded table on device
+        mid-manifest."""
+        out = self.manifest_many(streams)
+        hashes = [r.hash for refs in out for r in refs]
+        if hashes:
+            obs_profile.dispatch("index", actual_bytes=32 * len(hashes),
+                                 padded_bytes=32 * len(hashes))
+        return out, dedup.classify_insert(hashes)
+
     def manifest_stream(self, read: Callable[[int], bytes],
                         segment_bytes: int = 256 * 1024 * 1024,
                         emit: Optional[Callable] = None) -> List[ChunkRef]:
@@ -225,14 +241,29 @@ class TpuBackend(ChunkerBackend):
         self.params = params or CDCParams()
         self._scanner = TpuCdcScanner(self.params)
         self._pipeline = None
+        self._mesh = None
+        self._mesh_axis = "data"
 
     @property
     def pipeline(self):
         if self._pipeline is None:
             from .pipeline import CHUNK_LEN, DevicePipeline
             l_bucket = max(16, -(-self.params.max_size // CHUNK_LEN))
-            self._pipeline = DevicePipeline(self.params, l_bucket=l_bucket)
+            self._pipeline = DevicePipeline(self.params, l_bucket=l_bucket,
+                                            mesh=self._mesh,
+                                            mesh_axis=self._mesh_axis)
         return self._pipeline
+
+    def attach_mesh(self, mesh, axis: str = "data") -> None:
+        """Share the dedup mesh with the manifest pipeline so the
+        classified path shards its batches over the same axis and can
+        hand digest accumulators to the table without leaving the mesh
+        (the engine calls this when it builds its MeshDedupIndex)."""
+        self._mesh = mesh
+        self._mesh_axis = axis
+        if self._pipeline is not None and self._pipeline.mesh is None:
+            self._pipeline.mesh = mesh
+            self._pipeline.mesh_axis = axis
 
     def chunk(self, data):
         return self._scanner.chunk_stream(data)
@@ -256,6 +287,35 @@ class TpuBackend(ChunkerBackend):
                 ChunkRef(offset=off, length=ln, hash=digests[k].tobytes())
                 for k, (off, ln) in enumerate(chunks)])
         return out
+
+    def manifest_many_classified(self, streams, dedup):
+        """Mesh-sharded manifest with the on-device dedup handoff: the
+        digest accumulator feeds ``ShardedDedupIndex.insert_device``
+        without a host round trip, and the downloaded found-flags become
+        the packer's dup hints via ``resolve_hints``.  Falls back to the
+        two-pass base when ``dedup`` has no device handoff or rides a
+        different mesh than the pipeline."""
+        pipe = self.pipeline
+        if getattr(dedup, "classify_dispatch", None) is None:
+            return super().manifest_many_classified(streams, dedup)
+        if pipe.mesh is None:
+            pipe.mesh = dedup.mesh
+            pipe.mesh_axis = dedup.axis
+        if pipe.mesh is not dedup.mesh or pipe.mesh_axis != dedup.axis:
+            return super().manifest_many_classified(streams, dedup)
+        results, rowflags = pipe.manifest_batch_classified(streams, dedup)
+        out = []
+        hashes: List[bytes] = []
+        raw: List[Optional[bool]] = []
+        for (chunks, digests), fl in zip(results, rowflags):
+            refs = [ChunkRef(offset=off, length=ln,
+                             hash=digests[k].tobytes())
+                    for k, (off, ln) in enumerate(chunks)]
+            out.append(refs)
+            for k, ref in enumerate(refs):
+                hashes.append(ref.hash)
+                raw.append(None if fl is None else bool(fl[k]))
+        return out, dedup.resolve_hints(hashes, raw)
 
 
 def _accelerator_attached() -> bool:

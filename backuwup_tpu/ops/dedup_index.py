@@ -60,6 +60,25 @@ def hashes_to_queries(hashes) -> np.ndarray:
     return np.ascontiguousarray(buf)
 
 
+def queries_from_cvs(acc):
+    """Device-resident analog of :func:`hashes_to_queries`.
+
+    ``acc`` is a digest stage's ``(N, 8)`` u32 root-chaining-value
+    accumulator; the 32-byte digest is the little-endian serialization of
+    those words, so its first 16 bytes ARE words 0..3 — slicing on device
+    is numerically identical to downloading the digests and calling
+    :func:`hashes_to_queries`, with zero host round trips.  Unplaced
+    accumulator rows stay all-zero (``digest_pool.pool_digest`` scatters
+    only placed chunks into a zero-initialized accumulator), and all-zero
+    queries are exactly the probe kernel's padding convention, so the
+    whole slab feeds :meth:`ShardedDedupIndex.insert_device` unmasked.
+    (A real digest whose first 16 bytes happen to be zero — probability
+    2^-128 — reads as padding and classifies "new"; the host authority
+    still wins, the same stance as the 128-bit key truncation.)
+    """
+    return acc[:, :KEY_WORDS]
+
+
 @dataclass
 class ShardedDedupIndex:
     """Functional sharded hash table; state lives on the mesh."""

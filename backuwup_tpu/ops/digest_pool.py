@@ -38,6 +38,19 @@ flat pool of leaves through a single scan:
 Digests are bit-identical to :mod:`backuwup_tpu.ops.blake3_cpu` (the
 spec oracle) — property-tested in interpret mode and gated at runtime by
 ``DevicePipeline``'s parity ladder before production use.
+
+Mesh usage (``manifest_device.scan_digest_batch_pool_mesh``): each shard
+runs its own pool over its row slice with PER-SHARD ``leaf_cap``/``tiers``
+sized for ``B/D`` rows, so the ``(1,)`` overflow flag widens to one flag
+per shard and adversarial data re-runs only that shard's rows.  Two
+accumulator invariants the dedup handoff leans on: (a) ``acc`` is
+zero-initialized and only cascade-placed chunks scatter into it, so
+unplaced/invalid lanes stay all-zero — exactly the probe kernel's
+padding-query convention; (b) when the leaf pool itself overflows
+(``pool_short > 0``) the affected chunks still cascade-place but carry
+WRONG digests — the shard's overflow flag forces the host-tiled re-run
+for its manifests, and any wrong keys the handoff inserted are inert
+junk (2^-128 collision odds against real BLAKE3 prefixes).
 """
 
 from __future__ import annotations

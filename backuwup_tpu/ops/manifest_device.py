@@ -280,3 +280,74 @@ def scan_digest_batch_pool(buf_d: jnp.ndarray, nv_b: jnp.ndarray, *,
         flat, abs_offs, jnp.where(flat_valid, flat_lens, 0),
         leaf_cap=leaf_cap, tiers=tiers, pallas=pallas_digest)
     return packed, acc, ovf
+
+
+@functools.lru_cache(maxsize=32)
+def _mesh_scan_digest_fn(mesh, axis: str, min_size: int, desired_size: int,
+                         max_size: int, mask_s: int, mask_l: int, s_cap: int,
+                         l_cap: int, cut_cap: int, fused: bool, leaf_cap: int,
+                         tiers: Tuple[Tuple[int, int], ...],
+                         pallas_digest: bool, emit_queries: bool):
+    """Compile the shard-mapped leaf-pool manifest program for one mesh.
+
+    Each shard runs the SAME jitted :func:`scan_digest_batch_pool` body
+    over its contiguous slice of the row axis — per-shard leaf pool,
+    per-shard tier cascade, per-shard overflow flag.  ``out_specs``
+    concatenate shard outputs along that axis, so the global ``packed``
+    and ``acc`` keep the single-device addressing (``row*cut_cap+chunk``
+    in batch row order) while ``ovf`` widens from ``(1,)`` to ``(D,)``:
+    one flag PER SHARD, so adversarial data only re-runs the affected
+    shard's rows on the host-tiled path, not the whole batch.
+
+    With ``emit_queries`` each shard also slices its accumulator into a
+    ``(1, bs*cut_cap, 4)`` dedup query slab
+    (:func:`..dedup_index.queries_from_cvs`), giving a global
+    ``(D, bs*cut_cap, 4)`` array already laid out for
+    ``ShardedDedupIndex.insert_device`` — fingerprints flow
+    manifest -> dedup probe without ever leaving the mesh.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.jax_compat import shard_map
+    from .dedup_index import queries_from_cvs
+
+    def shard_fn(buf_d, nv_b):
+        packed, acc, ovf = scan_digest_batch_pool(
+            buf_d, nv_b, min_size=min_size, desired_size=desired_size,
+            max_size=max_size, mask_s=mask_s, mask_l=mask_l, s_cap=s_cap,
+            l_cap=l_cap, cut_cap=cut_cap, fused=fused, leaf_cap=leaf_cap,
+            tiers=tiers, pallas_digest=pallas_digest)
+        if emit_queries:
+            return packed, acc, ovf, queries_from_cvs(acc)[None]
+        return packed, acc, ovf
+
+    n_out = 4 if emit_queries else 3
+    mapped = shard_map(shard_fn, mesh=mesh, in_specs=(P(axis), P(axis)),
+                       out_specs=tuple([P(axis)] * n_out))
+    return jax.jit(mapped)
+
+
+def scan_digest_batch_pool_mesh(buf_d, nv_b, *, mesh, axis: str,
+                                min_size: int, desired_size: int,
+                                max_size: int, mask_s: int, mask_l: int,
+                                s_cap: int, l_cap: int, cut_cap: int,
+                                fused: bool, leaf_cap: int,
+                                tiers: Tuple[Tuple[int, int], ...],
+                                pallas_digest: bool = False,
+                                emit_queries: bool = False):
+    """Mesh twin of :func:`scan_digest_batch_pool` — same contract,
+    data-parallel over the row axis with ``shard_map``.
+
+    ``buf_d``/``nv_b`` must be sharded ``P(axis)`` over a row count
+    divisible by the mesh size; ``leaf_cap``/``tiers`` are PER-SHARD
+    capacities (sized for ``B/D`` rows).  Returns
+    ``(packed, acc, ovf[, queries])`` where ``ovf`` is the ``(D,)``
+    per-shard overflow vector.  Bit-identical to the single-device path:
+    a shard sees exactly the rows a ``B/D``-row single-device batch would,
+    and every kernel is row-independent (parity-ladder posture — a mesh
+    that mis-lowers loses speed, never correctness).
+    """
+    fn = _mesh_scan_digest_fn(mesh, axis, min_size, desired_size, max_size,
+                              mask_s, mask_l, s_cap, l_cap, cut_cap, fused,
+                              leaf_cap, tiers, pallas_digest, emit_queries)
+    return fn(buf_d, nv_b)

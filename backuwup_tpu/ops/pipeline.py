@@ -28,7 +28,7 @@ time on the CPU (``dir_packer.rs:246-311``).
 from __future__ import annotations
 
 import functools
-from collections import deque
+from collections import OrderedDict, deque
 from typing import List, Optional, Tuple
 
 import jax
@@ -182,14 +182,21 @@ class DevicePipeline:
     """Chunk + fingerprint segments that already live (or land) in HBM."""
 
     def __init__(self, params: Optional[CDCParams] = None,
-                 l_bucket: int = 3072, b_bucket: int = 128):
+                 l_bucket: int = 3072, b_bucket: int = 128,
+                 mesh=None, mesh_axis: str = "data"):
         self.params = params or CDCParams()
         self.scanner = TpuCdcScanner(self.params)
         if self.params.max_size > l_bucket * CHUNK_LEN:
             raise ValueError("l_bucket smaller than max chunk size")
         self.l_bucket = l_bucket
         self.b_bucket = b_bucket
-        self._nv_cache: dict = {}
+        # mesh for the shard-mapped driver (manifest_segments_mesh);
+        # lazily defaults to a single axis over every local device
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+        # per-device peak bytes in flight across the mesh dispatch window
+        self.mesh_hbm_high_water: dict = {}
+        self._nv_cache: OrderedDict = OrderedDict()
         from .blake3_tpu import pallas_digest_available
         from .digest_pool import pool_digest_available
         from .scan_fused import fused_scan_available
@@ -221,9 +228,14 @@ class DevicePipeline:
         key = nv.tobytes()
         nv_d = self._nv_cache.get(key)
         if nv_d is None:
-            if len(self._nv_cache) > 64:
-                self._nv_cache.clear()
+            # LRU: evict the coldest entry; the old wholesale clear()
+            # dropped hot entries (e.g. the full-batch nv that recurs on
+            # every steady-state dispatch) on every 65th distinct shape
+            while len(self._nv_cache) >= 64:
+                self._nv_cache.popitem(last=False)
             nv_d = self._nv_cache[key] = jnp.asarray(nv)
+        else:
+            self._nv_cache.move_to_end(key)
         return nv_d
 
     def scan_select_dispatch(self, buf_d: jnp.ndarray,
@@ -513,6 +525,207 @@ class DevicePipeline:
                 out.append((chunks, dig8[r, :len(chunks)].copy()))
             yield out
 
+    def _ensure_mesh(self):
+        """The mesh for the shard-mapped driver; defaults to one axis
+        over every local device (the engine's dedup mesh shape)."""
+        if self.mesh is None:
+            from jax.sharding import Mesh
+            self.mesh = Mesh(np.array(jax.devices()), (self.mesh_axis,))
+        return self.mesh
+
+    def manifest_segments_mesh(self, segments, strict_overflow: bool = False,
+                               window: int = 4, dedup=None):
+        """Multi-device pipelined driver (generator): the zero-round-trip
+        manifest of :meth:`manifest_segments_device`, data-parallel over
+        the row axis with ``shard_map``.
+
+        Each batch is padded to a row multiple of the mesh size with
+        zero rows (``nv=0`` rows produce no cuts), resharded ``P(axis)``,
+        and run through
+        :func:`backuwup_tpu.ops.manifest_device.scan_digest_batch_pool_mesh`
+        — per-shard leaf pools, per-shard tier cascades, and per-shard
+        overflow flags, so a pool overflow re-runs ONLY the affected
+        shard's rows on the host-tiled path.  ``window`` bounds batches in
+        flight; per-device bytes in flight are tracked against
+        ``bkw_mesh_hbm_highwater_bytes`` and ``mesh_hbm_high_water``.
+
+        With ``dedup`` (a ``MeshDedupIndex``) each batch's digest
+        accumulator is handed to the sharded dedup table ON DEVICE
+        (``classify_dispatch``) — zero per-batch host round trips — and
+        the generator yields ``(rows, flags)`` where ``flags[r]`` is the
+        per-chunk device found-vector (truthy = key resident before that
+        batch's insert) or ``None`` when the device could not classify
+        the row (shard fallback, candidate overflow, lost lanes);
+        ``MeshDedupIndex.resolve_hints`` turns the raw flags into final
+        dup hints.  Without ``dedup`` it yields plain rows, bit-identical
+        to the single-device driver.
+        """
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from .digest_pool import leaf_capacity
+        from .manifest_device import scan_digest_batch_pool_mesh, tier_plan
+
+        if not self.pool_digest:
+            # parity ladder: no mesh twin for the class-tile digest —
+            # fall back to the single-device driver (flags all None, the
+            # host authority classifies)
+            for rows in self.manifest_segments_device(
+                    segments, strict_overflow, window):
+                yield (rows, [None] * len(rows)) if dedup is not None \
+                    else rows
+            return
+
+        mesh = self._ensure_mesh()
+        axis = self.mesh_axis
+        D = int(mesh.devices.size)
+        sharding = NamedSharding(mesh, P(axis))
+        p = self.params
+        it = iter(segments)
+        pending: deque = deque()
+        state = {"in_flight": 0}
+
+        def dispatch():
+            for buf, nv in it:
+                B0 = int(buf.shape[0])
+                row = int(buf.shape[1])
+                nv = np.asarray(nv, dtype=np.int32)
+                B = -(-max(B0, 1) // D) * D
+                if B != B0:
+                    if isinstance(buf, np.ndarray):
+                        buf = np.pad(buf, ((0, B - B0), (0, 0)))
+                    else:
+                        buf = jnp.pad(buf, ((0, B - B0), (0, 0)))
+                    nv = np.pad(nv, (0, B - B0))
+                bs = B // D
+                padded = row - _HALO
+                s_cap, l_cap, cut_cap = self._caps(padded)
+                with tracing.span("pipeline.mesh_dispatch"):
+                    buf_sh = jax.device_put(buf, sharding)
+                    nv_sh = jax.device_put(nv, sharding)
+                    rets = scan_digest_batch_pool_mesh(
+                        buf_sh, nv_sh, mesh=mesh, axis=axis,
+                        min_size=p.min_size, desired_size=p.desired_size,
+                        max_size=p.max_size, mask_s=p.mask_s,
+                        mask_l=p.mask_l, s_cap=s_cap, l_cap=l_cap,
+                        cut_cap=cut_cap, fused=self.fused,
+                        leaf_cap=leaf_capacity(bs * padded, bs * cut_cap),
+                        tiers=tier_plan(p, bs * padded, bs),
+                        pallas_digest=self.pallas_digest,
+                        emit_queries=dedup is not None)
+                    if dedup is not None:
+                        packed, acc, ovf, q = rets
+                        found_d, lost_d = dedup.classify_dispatch(q)
+                    else:
+                        packed, acc, ovf = rets
+                        found_d = lost_d = None
+                for a in (packed, acc, ovf, found_d, lost_d):
+                    if a is not None:
+                        _async_to_host(a)
+                # accounting: ONE launch per stage (the shard_map program)
+                # in the unlabeled families, plus each device's share in
+                # the mesh families — per-shard actual bytes come from its
+                # contiguous nv slice, padded bytes are its row span
+                actual = int(nv.sum(dtype=np.int64))
+                for stage in ("scan", "select", "gather", "digest"):
+                    obs_profile.dispatch(stage, actual_bytes=actual,
+                                         padded_bytes=B * padded)
+                per_dev = nv.reshape(D, bs).sum(axis=1, dtype=np.int64)
+                for d in range(D):
+                    for stage in ("scan", "select", "gather", "digest"):
+                        obs_profile.dispatch_device(
+                            stage, d, actual_bytes=int(per_dev[d]),
+                            padded_bytes=bs * padded)
+                # per-device bytes in flight: row buffer + packed cuts +
+                # digest accumulator + ovf flag (+ dedup query/value lanes)
+                foot = (bs * row + bs * (2 + cut_cap) * 4
+                        + bs * cut_cap * 32 + 4)
+                if dedup is not None:
+                    foot += bs * cut_cap * (16 + 4)
+                state["in_flight"] += foot
+                for d in range(D):
+                    obs_profile.hbm_high_water(d, state["in_flight"])
+                    if state["in_flight"] > self.mesh_hbm_high_water.get(d, 0):
+                        self.mesh_hbm_high_water[d] = state["in_flight"]
+                pending.append((buf, nv, B0, cut_cap, foot,
+                                packed, acc, ovf, found_d, lost_d))
+                return True
+            return False
+
+        for _ in range(window):
+            dispatch()
+        while pending:
+            (buf, nv, B0, cut_cap, foot, packed_d, acc_d, ovf_d,
+             found_d, lost_d) = pending.popleft()
+            dispatch()
+            with tracing.span("pipeline.mesh_collect"):
+                packed = np.asarray(packed_d)
+                ovf = np.asarray(ovf_d)  # (D,) per-shard flags
+            state["in_flight"] -= foot
+            B = packed.shape[0]
+            bs = B // D
+            if ovf.any() and strict_overflow:
+                raise RuntimeError("pool capacity overflow in mesh manifest")
+            bad = set(np.nonzero(ovf)[0].tolist())
+            dig8 = None
+            if len(bad) < D:
+                acc = np.asarray(acc_d)
+                dig8 = np.ascontiguousarray(acc.astype("<u4")).view(
+                    np.uint8).reshape(B, cut_cap, 32)
+            found = lost = None
+            if found_d is not None:
+                with tracing.span("pipeline.mesh_collect"):
+                    found = np.asarray(found_d).reshape(B, cut_cap)
+                    lost = np.asarray(lost_d).reshape(B, cut_cap)
+                n_real = int(packed[packed[:, 0] == 0, 1].sum())
+                obs_profile.dispatch("index", actual_bytes=32 * n_real,
+                                     padded_bytes=32 * B * cut_cap)
+                for d in range(D):
+                    sl = packed[d * bs:(d + 1) * bs]
+                    obs_profile.dispatch_device(
+                        "index", d,
+                        actual_bytes=32 * int(sl[sl[:, 0] == 0, 1].sum()),
+                        padded_bytes=32 * bs * cut_cap)
+            hb = buf if isinstance(buf, np.ndarray) else None
+            out: List = [None] * B
+            flags: List = [None] * B
+            for s in range(D):
+                r0, r1 = s * bs, (s + 1) * bs
+                if s in bad:
+                    # per-shard fallback: ONLY this shard's rows re-run on
+                    # the host-tiled path (the tentpole's whole point —
+                    # adversarial data costs one shard, not the batch)
+                    if hb is None:
+                        hb = np.asarray(buf)
+                    sub = self.manifest_resident_batch(
+                        jnp.asarray(hb[r0:r1]), nv[r0:r1])
+                    for r in range(r0, min(r1, B0)):
+                        out[r] = sub[r - r0]
+                    continue
+                for r in range(r0, min(r1, B0)):
+                    overflow, chunks = _decode_cut_row(packed[r])
+                    if overflow:
+                        if strict_overflow:
+                            raise RuntimeError(
+                                "candidate overflow in scan+select")
+                        if hb is None:
+                            hb = np.asarray(buf)
+                        rowb = bytes(hb[r, _HALO:_HALO + int(nv[r])])
+                        chunks = chunk_stream_cpu(rowb, self.params)
+                        digs = np.stack([np.frombuffer(
+                            _blake3_host(rowb[o:o + ln]), dtype=np.uint8)
+                            for o, ln in chunks]) if chunks else \
+                            np.zeros((0, 32), dtype=np.uint8)
+                        out[r] = (chunks, digs)
+                        continue
+                    out[r] = (chunks, dig8[r, :len(chunks)].copy())
+                    if found is not None and not lost[r, :len(chunks)].any():
+                        flags[r] = found[r, :len(chunks)] != 0
+            if dedup is not None:
+                yield out[:B0], flags[:B0]
+            else:
+                yield out[:B0]
+
     def process_segment(self, stream: jnp.ndarray, n_valid: int,
                         prev_tail: bytes = b"") -> Tuple[List[tuple], np.ndarray]:
         """One resident segment -> (chunks [(offset, length)...], digests).
@@ -528,18 +741,11 @@ class DevicePipeline:
         (chunks, digests), = self.manifest_resident_batch(ext, nv)
         return chunks, digests
 
-    def manifest_batch(self, streams) -> List[Tuple[List[tuple], np.ndarray]]:
-        """Chunk + fingerprint a batch of independent streams, resident.
-
-        Each stream's bytes are staged into HBM exactly once: streams are
-        bucketed by padded length, scanned+selected with one fused dispatch
-        per bucket, and chunk buffers are gathered HBM->HBM out of the same
-        resident batch before the batched BLAKE3.  Returns a
-        ``(chunks, digests)`` pair per stream, bit-identical to the CPU
-        oracle pipeline.
-        """
+    def _manifest_prepass(self, streams, out: List) -> dict:
+        """Route a stream batch: fills ``out`` for empty/tiny/long streams
+        (the non-batched shapes) and returns the {padded_len: [idx...]}
+        groups the resident batch drivers consume."""
         p = self.params
-        out: List[Optional[Tuple[List[tuple], np.ndarray]]] = [None] * len(streams)
         tiny: List[int] = []
         groups: dict = {}
         for i, s in enumerate(streams):
@@ -569,39 +775,76 @@ class DevicePipeline:
             for i, d in zip(tiny, digs):
                 out[i] = ([(0, len(streams[i]))],
                           np.frombuffer(d, dtype=np.uint8).reshape(1, 32))
+        return groups
+
+    def _bucketed_batches(self, streams, groups: dict, batch_rows: deque):
+        """Generator of (host buf, nv) resident batches for the grouped
+        streams; appends each batch's stream indices to ``batch_rows``."""
+        for padded, idxs in sorted(groups.items()):
+            row = _HALO + padded
+            max_rows = max(1, _SCAN_DISPATCH_BYTES // row)
+            # pow2 row padding, clamped by the dispatch budget (largest
+            # pow2 <= max_rows): a lone 128 MiB stream must not balloon
+            # to 8 identical rows, and a full part must not double past
+            # the budget — so slice by the pow2 cap itself
+            b_cap = 1 << (max_rows.bit_length() - 1)
+            for s0 in range(0, len(idxs), b_cap):
+                part = idxs[s0:s0 + b_cap]
+                B = min(8, b_cap)
+                while B < len(part):
+                    B *= 2
+                buf = np.zeros((B, row), dtype=np.uint8)
+                nv = np.zeros(B, dtype=np.int32)
+                for r, i in enumerate(part):
+                    d = np.frombuffer(bytes(streams[i]), dtype=np.uint8)
+                    buf[r, _HALO:_HALO + len(d)] = d
+                    nv[r] = len(d)
+                batch_rows.append(part)
+                yield buf, nv
+
+    def manifest_batch(self, streams) -> List[Tuple[List[tuple], np.ndarray]]:
+        """Chunk + fingerprint a batch of independent streams, resident.
+
+        Each stream's bytes are staged into HBM exactly once: streams are
+        bucketed by padded length, scanned+selected with one fused dispatch
+        per bucket, and chunk buffers are gathered HBM->HBM out of the same
+        resident batch before the batched BLAKE3.  Returns a
+        ``(chunks, digests)`` pair per stream, bit-identical to the CPU
+        oracle pipeline.
+        """
+        out: List[Optional[Tuple[List[tuple], np.ndarray]]] = [None] * len(streams)
+        groups = self._manifest_prepass(streams, out)
         # stage resident batches lazily through the pipelined driver: at
         # most ~3 batches (each bounded by the dispatch budget) live in HBM
         # at once, however large the whole call is
         batch_rows: deque = deque()
-
-        def batch_gen():
-            for padded, idxs in sorted(groups.items()):
-                row = _HALO + padded
-                max_rows = max(1, _SCAN_DISPATCH_BYTES // row)
-                # pow2 row padding, clamped by the dispatch budget (largest
-                # pow2 <= max_rows): a lone 128 MiB stream must not balloon
-                # to 8 identical rows, and a full part must not double past
-                # the budget — so slice by the pow2 cap itself
-                b_cap = 1 << (max_rows.bit_length() - 1)
-                for s0 in range(0, len(idxs), b_cap):
-                    part = idxs[s0:s0 + b_cap]
-                    B = min(8, b_cap)
-                    while B < len(part):
-                        B *= 2
-                    buf = np.zeros((B, row), dtype=np.uint8)
-                    nv = np.zeros(B, dtype=np.int32)
-                    for r, i in enumerate(part):
-                        d = np.frombuffer(bytes(streams[i]), dtype=np.uint8)
-                        buf[r, _HALO:_HALO + len(d)] = d
-                        nv[r] = len(d)
-                    batch_rows.append(part)
-                    yield jnp.asarray(buf), nv
-
-        for results in self.manifest_segments(batch_gen()):
+        gen = ((jnp.asarray(b), nv) for b, nv in
+               self._bucketed_batches(streams, groups, batch_rows))
+        for results in self.manifest_segments(gen):
             part = batch_rows.popleft()
             for r, i in enumerate(part):
                 out[i] = results[r]
         return out
+
+    def manifest_batch_classified(self, streams, dedup):
+        """:meth:`manifest_batch` through the mesh driver with the
+        on-device dedup handoff: returns ``(out, flags)`` where
+        ``flags[i]`` is stream i's per-chunk device found-vector or
+        ``None`` when the device could not classify it (empty/tiny/long
+        streams, shard fallbacks, lost lanes — the host authority
+        resolves those via ``MeshDedupIndex.resolve_hints``).
+        """
+        out: List[Optional[Tuple[List[tuple], np.ndarray]]] = [None] * len(streams)
+        flags: List[Optional[np.ndarray]] = [None] * len(streams)
+        groups = self._manifest_prepass(streams, out)
+        batch_rows: deque = deque()
+        gen = self._bucketed_batches(streams, groups, batch_rows)
+        for rows, rowflags in self.manifest_segments_mesh(gen, dedup=dedup):
+            part = batch_rows.popleft()
+            for r, i in enumerate(part):
+                out[i] = rows[r]
+                flags[i] = rowflags[r]
+        return out, flags
 
     def _chunk_bucket(self, n_bytes: int) -> int:
         """Smallest leaf bucket (power of two, >=16 chunks) holding a chunk;

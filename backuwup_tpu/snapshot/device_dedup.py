@@ -51,6 +51,9 @@ class MeshDedupIndex:
         need = max(defaults.DEDUP_SHARD_CAPACITY,
                    _next_pow2(4 * max(known, 1) // max(n_dev, 1)))
         self.capacity = capacity or need
+        # sharded all-ones value slabs for classify_dispatch, keyed by
+        # per-shard lane count (insert_device never donates its value arg)
+        self._ones_cache: dict = {}
         self._rebuild()
 
     def _rebuild(self) -> None:
@@ -80,6 +83,94 @@ class MeshDedupIndex:
             except DedupIndexFull:
                 cap *= 4
         self.capacity = cap
+
+    def classify_dispatch(self, q_dev):
+        """Device-resident classify+insert of a sharded query slab.
+
+        ``q_dev`` is the ``(D, n, 4)`` u32 slab straight off the mesh
+        manifest (``queries_from_cvs`` of the digest accumulator) — the
+        fingerprints never visit the host.  New keys insert with value 1;
+        returns the ``(found, lost)`` device vectors WITHOUT any host
+        synchronization: ``found != 0`` means the key was resident BEFORE
+        this batch's insert, nonzero ``lost`` lanes (residual races /
+        exhausted probes) must be resolved against the host authority —
+        :meth:`resolve_hints` does both.
+        """
+        d, n = int(q_dev.shape[0]), int(q_dev.shape[1])
+        return self.sharded.insert_device(q_dev, self._ones(d, n))
+
+    def _ones(self, d: int, n: int):
+        v = self._ones_cache.get(n)
+        if v is None:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            if len(self._ones_cache) > 16:
+                self._ones_cache.clear()
+            v = self._ones_cache[n] = jax.device_put(
+                jnp.ones((d, n), dtype=jnp.uint32),
+                NamedSharding(self.mesh, P(self.axis)))
+        return v
+
+    def resolve_hints(self, hashes: List[bytes],
+                      raw: List[Optional[bool]]) -> List[bool]:
+        """Merge per-occurrence device found-flags into final dup hints.
+
+        ``raw[i]`` is occurrence i's flag from :meth:`classify_dispatch`
+        (truthy = key resident before its insert batch) or ``None`` when
+        the device path could not classify it (shard fallback, candidate
+        overflow, lost lane, tiny/long/empty stream).  Device semantics
+        collapse cleanly: occurrences of one hash within one insert batch
+        all report the pre-batch state, and a later batch of the same
+        flush sees the earlier batch's insert as resident — so ANDing the
+        concrete flags recovers "was it resident before the flush", and
+        the ref-order walk below restores first-occurrence-new /
+        repeat-duplicate.  Any ``None`` occurrence poisons the hash to
+        ``None``: the host authority answers, and the hash is re-inserted
+        host-side so the device table stays a superset of the pack batch
+        (fallback shards may have inserted a wrong-digest key — harmless
+        junk at 2^-128 collision odds, same stance as the 128-bit key
+        truncation).
+        """
+        hashes = [bytes(h) for h in hashes]
+        if not hashes:
+            return []
+        _unset = object()
+        facts: dict = {}
+        for h, f in zip(hashes, raw):
+            prev = facts.get(h, _unset)
+            if prev is None:
+                continue
+            if f is None:
+                facts[h] = None
+            elif prev is _unset:
+                facts[h] = bool(f)
+            else:
+                facts[h] = prev and bool(f)
+        pend = [h for h, f in facts.items() if f is None]
+        host_facts = {}
+        if pend:
+            for h in pend:
+                host_facts[h] = self.host.is_duplicate(h)
+            q = hashes_to_queries(pend)
+            vals = np.ones(len(pend), dtype=np.uint32)
+            while True:
+                try:
+                    self.sharded.insert(q, vals)
+                    break
+                except DedupIndexFull:
+                    self._grow()
+        flags: List[bool] = []
+        seen: set = set()
+        for h in hashes:
+            if h in seen:
+                flags.append(True)
+            else:
+                seen.add(h)
+                f = facts[h]
+                flags.append(host_facts[h] if f is None else f)
+        return flags
 
     def classify_insert(self, hashes: List[bytes]) -> List[bool]:
         """is-duplicate flag per hash; new hashes become table-resident.

@@ -62,15 +62,23 @@ class DirPacker:
                  progress: Optional[Callable] = None,
                  batch_bytes: int = 256 * defaults.MiB,
                  should_pause: Optional[Callable] = None,
-                 dedup_batch: Optional[Callable] = None):
+                 dedup_batch: Optional[Callable] = None,
+                 dedup_index=None):
         self.backend = backend
         self.writer = writer
         self.index = index
         self.progress = progress or (lambda **kw: None)
         self.batch_bytes = batch_bytes
         self.should_pause = should_pause or (lambda: None)
-        # device dedup front: batched is-duplicate classify+insert
-        # (MeshDedupIndex.classify_insert); None = host-only dedup
+        # device dedup front.  ``dedup_index`` (a MeshDedupIndex) is the
+        # full handle: pack batches then classify through the backend's
+        # fused manifest+classify seam (on the TPU backend the digests
+        # reach the sharded table without leaving the mesh).
+        # ``dedup_batch`` is the narrower legacy hook (batched
+        # classify+insert callable); None for both = host-only dedup.
+        self.dedup_index = dedup_index
+        if dedup_batch is None and dedup_index is not None:
+            dedup_batch = dedup_index.classify_insert
         self.dedup_batch = dedup_batch
         self._device_sync: List[bytes] = []
         self.stats = PackStats()
@@ -145,27 +153,41 @@ class DirPacker:
             if not batch_idx:
                 return
             t0 = time.monotonic()
-            with tracing.span("packer.manifest_many"):
-                manifests = self.backend.manifest_many(batch_data)
+            hint_list = None
+            if self.dedup_index is not None:
+                # blobs classified host-side since the last batch (streamed
+                # chunks, tree nodes) must reach the device table BEFORE
+                # this batch is classified, or a re-occurrence of one of
+                # them would read as device-new/host-dup and trip the
+                # divergence guard in _add_blob
+                self._flush_device_sync()
+                # fused manifest+classify: on the TPU backend each digest
+                # batch hands its accumulator to the sharded table on
+                # device (zero per-batch host round trips); index-stage
+                # dispatches are accounted inside the backend/driver
+                with tracing.span("packer.manifest_many"):
+                    manifests, hint_list = \
+                        self.backend.manifest_many_classified(
+                            batch_data, self.dedup_index)
+            else:
+                with tracing.span("packer.manifest_many"):
+                    manifests = self.backend.manifest_many(batch_data)
             dt = time.monotonic() - t0
             self.stats.chunk_hash_s += dt
             _STAGE_SECONDS.observe(dt, stage="chunk_hash")
             total_refs = sum(len(m) for m in manifests)
-            if total_refs:
+            if total_refs and hint_list is None:
                 # one batched dedup classification per pack batch, whether
                 # the device table or the host blob index answers it
                 obs_profile.dispatch("index", actual_bytes=32 * total_refs,
                                      padded_bytes=32 * total_refs)
             hints = iter(())
-            if self.dedup_batch is not None:
-                # blobs classified host-side since the last batch (streamed
-                # chunks, tree nodes) must reach the device table BEFORE the
-                # new batch is classified, or a re-occurrence of one of them
-                # would read as device-new/host-dup and trip the divergence
-                # guard in _add_blob
+            if hint_list is not None:
+                hints = iter(hint_list)
+            elif self.dedup_batch is not None:
+                # legacy hook path (no full index handle): same sync-then-
+                # classify ordering, one device round trip for the batch
                 self._flush_device_sync()
-                # one device round-trip classifies every chunk of the batch
-                # against the sharded HBM table (SURVEY.md section 7 3e)
                 hints = iter(self.dedup_batch(
                     [ref.hash for m in manifests for ref in m]))
             for i, data, meta, manifest in zip(batch_idx, batch_data,

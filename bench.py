@@ -248,6 +248,12 @@ def main() -> None:
     if "speedup" in restore:
         record["restore_speedup"] = restore["speedup"]
         record["restore_bytes_ratio"] = restore.get("bytes_ratio")
+    # config #14 is the mesh manifest plane: surface the matched-work
+    # multichip speedup at top level (parity/even-split/handoff gates run
+    # everywhere; the wall-clock gate arms on hardware only)
+    multichip = configs.get("14_multichip", {})
+    if "speedup" in multichip:
+        record["multichip_speedup"] = multichip["speedup"]
     print(json.dumps({
         **record,
         "note": "corpus synthesized on-device (host<->device relay tunnel "

@@ -29,11 +29,16 @@ identical dedup output is meaningless):
   #12 swarm               — sharded vs single-lock coordination plane:
       direct matchmaking-layer speedup legs plus the HTTP swarm
       scenario's p99/stall/off-loop-commit evidence (gate: ≥ 2x)
+  #14 multichip           — matched-work 1-device vs N-device mesh
+      manifest (shard_map scan→digest + device-resident dedup handoff);
+      parity/even-split/handoff gates always on, wall-clock speedup
+      gate armed on hardware only
 
 Environment knobs: BENCH_C2_FILES, BENCH_C3_MIB, BENCH_C4_GIB,
 BENCH_C5_HASHES, BENCH_C6_MIB, BENCH_C7_SHARD_KIB, BENCH_C7_STRIPES,
 BENCH_C8_MIB, BENCH_C8_PEERS, BENCH_C8_LATENCY_S, BENCH_C10_KIB,
-BENCH_C10_CHUNK_KIB, BENCH_C12_CLIENTS, BENCH_C12_S.
+BENCH_C10_CHUNK_KIB, BENCH_C12_CLIENTS, BENCH_C12_S, BENCH_C14_DEVICES,
+BENCH_C14_ROWS_PER_DEV, BENCH_C14_ROW_KIB, BENCH_C14_SPEEDUP_GATE.
 """
 
 from __future__ import annotations
@@ -1192,6 +1197,147 @@ def config13_restore(log: Callable) -> Dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def config14_multichip(log: Callable, n_devices: int = 0) -> Dict:
+    """Matched-work single-device vs mesh manifest plane — config #14.
+
+    The SAME staged batch (``BENCH_C14_ROWS_PER_DEV`` rows per device x
+    ``BENCH_C14_ROW_KIB`` KiB of random bytes) runs through the
+    zero-round-trip single-device driver and through the shard-mapped
+    mesh driver (:meth:`DevicePipeline.manifest_segments_mesh`) with the
+    manifest->dedup handoff attached (``MeshDedupIndex``), so the record
+    captures the whole production multi-chip path: per-shard leaf pools,
+    per-device dispatch accounting, and device-resident classify.
+
+    Gates enforced on EVERY platform (forced-8 CPU mesh included):
+
+      * parity — mesh rows bit-identical to the single-device rows, and
+        to the CPU oracle on a downloaded row
+      * even split — per-device digest dispatch counts within +-1
+      * handoff — index-stage dispatches == device batches (classify
+        rides ``insert_device``; zero per-batch host round trips), and
+        the device found-vector classifies the warmed corpus duplicate
+
+    The wall-clock gate (``speedup >= BENCH_C14_SPEEDUP_GATE``, default
+    1.5) arms only on real hardware: a forced-8-device CPU "mesh"
+    timeshares one host core pool, so its speedup measures shard_map
+    overhead, not scale.
+    """
+    import pathlib
+    import shutil
+    import tempfile
+
+    from jax.sharding import Mesh
+
+    from backuwup_tpu.crypto import KeyManager
+    from backuwup_tpu.obs import profile as obs_profile
+    from backuwup_tpu.snapshot.blob_index import BlobIndex
+    from backuwup_tpu.snapshot.device_dedup import MeshDedupIndex
+
+    n_dev = n_devices or int(os.environ.get("BENCH_C14_DEVICES", "8"))
+    n_dev = max(1, min(n_dev, jax.device_count()))
+    rows_per_dev = int(os.environ.get("BENCH_C14_ROWS_PER_DEV", "2"))
+    P = int(os.environ.get("BENCH_C14_ROW_KIB", "1024")) << 10
+    B = n_dev * rows_per_dev
+    params = CDCParams.from_desired(16 << 10)
+    pass_mib = B * P / (1 << 20)
+
+    pipe1 = DevicePipeline(params)
+    if not pipe1.pool_digest:
+        log("config#14: leaf-pool digest unavailable; mesh plane skipped")
+        return {"skipped": "pool_digest unavailable"}
+
+    rng = np.random.default_rng(141)
+    buf = np.zeros((B, _HALO + P), dtype=np.uint8)
+    buf[:, _HALO:] = rng.integers(0, 256, (B, P), dtype=np.uint8)
+    nv = np.full(B, P, dtype=np.int32)
+    buf1 = jnp.asarray(buf)
+
+    # --- leg 1: single device, zero-round-trip driver ---------------------
+    (single,) = list(pipe1.manifest_segments_device(
+        [(buf1, nv)], strict_overflow=True))  # warm + parity reference
+    w1 = SustainedWindow(2)
+    for _ in w1.passes():
+        for _rows in pipe1.manifest_segments_device([(buf1, nv)],
+                                                    strict_overflow=True):
+            pass
+    mibs1 = w1.count * pass_mib / w1.wall
+
+    # --- leg 2: mesh driver + device-resident dedup handoff ---------------
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("data",))
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="bkw_bench_c14_"))
+    try:
+        dedup = MeshDedupIndex(
+            mesh, BlobIndex(KeyManager.from_secret(b"\x0e" * 32),
+                            tmp / "index"))
+        pipe_n = DevicePipeline(params, mesh=mesh)
+        ((mesh_rows, _fl),) = list(pipe_n.manifest_segments_mesh(
+            [(buf, nv)], strict_overflow=True, dedup=dedup))  # warm
+        for r in range(B):
+            if mesh_rows[r][0] != single[r][0] or not np.array_equal(
+                    mesh_rows[r][1], single[r][1]):
+                raise RuntimeError("config #14: mesh/single parity FAILED")
+        _check(mesh_rows[0], bytes(buf[0, _HALO:]), params, "#14")
+
+        base = obs_profile.baseline()
+        batches = 0
+        dup_flags_ok = True
+        w2 = SustainedWindow(2)
+        for _ in w2.passes():
+            for _rows, flags in pipe_n.manifest_segments_mesh(
+                    [(buf, nv)], strict_overflow=True, dedup=dedup):
+                batches += 1
+                for fl in flags:
+                    # the warm pass made every key resident: the device
+                    # found-vector must classify all-duplicate
+                    if fl is None or not all(bool(x) for x in fl):
+                        dup_flags_ok = False
+        mibs_n = w2.count * pass_mib / w2.wall
+        rep = obs_profile.report(base)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    dev_disp = rep.get("device_dispatches", {})
+    digest_counts = [dev_disp.get(str(d), {}).get("digest", 0)
+                     for d in range(n_dev)]
+    delta = max(digest_counts) - min(digest_counts)
+    if delta > 1:
+        raise RuntimeError(f"config #14: uneven shard split {digest_counts}")
+    if rep["dispatches"]["index"] != batches:
+        raise RuntimeError(
+            f"config #14: handoff made host round trips "
+            f"({rep['dispatches']['index']} index dispatches for "
+            f"{batches} batches)")
+    for d in range(n_dev):
+        if dev_disp.get(str(d), {}).get("index", 0) != batches:
+            raise RuntimeError(
+                f"config #14: device {d} index dispatches "
+                f"{dev_disp.get(str(d), {}).get('index', 0)} != {batches}")
+    if not dup_flags_ok:
+        raise RuntimeError("config #14: device classify missed residency")
+
+    speedup = mibs_n / mibs1 if mibs1 > 0 else 0.0
+    gate = float(os.environ.get("BENCH_C14_SPEEDUP_GATE", "1.5"))
+    armed = jax.devices()[0].platform != "cpu"
+    if armed and speedup < gate:
+        raise RuntimeError(
+            f"config #14: multichip speedup {speedup:.2f}x < {gate}x")
+    log(f"config#14 multichip: 1dev {mibs1:.1f} MiB/s vs {n_dev}dev "
+        f"{mibs_n:.1f} MiB/s = {speedup:.2f}x "
+        f"({'gate armed' if armed else 'gate recorded only, CPU mesh'}; "
+        f"digest split {digest_counts})")
+    return {"n_devices": n_dev, "mib_s_1dev": round(mibs1, 2),
+            "mib_s_mesh": round(mibs_n, 2), "speedup": round(speedup, 3),
+            "speedup_gate_armed": armed,
+            "device_dispatches": dev_disp,
+            "device_pad_efficiency": rep.get("device_pad_efficiency", {}),
+            "even_split_max_delta": delta,
+            "index_dispatches": rep["dispatches"]["index"],
+            "batches": batches,
+            "hbm_high_water_bytes": max(
+                pipe_n.mesh_hbm_high_water.values(), default=0),
+            "wall_s": round(w1.wall + w2.wall, 2)}
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -1209,7 +1355,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("10_wan", lambda: config10_wan(log)),
             ("11_crash", lambda: config11_crash(log)),
             ("12_swarm", lambda: config12_swarm(log)),
-            ("13_restore", lambda: config13_restore(log))):
+            ("13_restore", lambda: config13_restore(log)),
+            ("14_multichip", lambda: config14_multichip(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

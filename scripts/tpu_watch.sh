@@ -114,6 +114,20 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "restore bench recapture FAILED (see $rst) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated multichip recapture: config #14 alone (mesh manifest
+        # plane: matched-work 1-dev vs N-dev shard_map manifest with the
+        # manifest->dedup device handoff; parity/even-split/handoff gates
+        # always on, wall-clock speedup gate armed on real chips) — the
+        # multichip_speedup number survives even when the device suite
+        # timed out partway
+        mcp="$BENCH_OUT_DIR/BENCH_multichip_${stamp}.json"
+        if timeout "${BENCH_MULTICHIP_TIMEOUT_S:-900}" \
+                env BENCH_ONLY_CONFIG=14_multichip BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$mcp" 2>>/tmp/tpu_watch.log; then
+            echo "multichip bench recaptured to $mcp at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "multichip bench recapture FAILED (see $mcp) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log
