@@ -72,6 +72,7 @@ class ClientApp:
         self.node.on_transport_request = self._accept_peer_data
         self.node.on_restore_request = self._serve_restore
         self.node.on_restore_fetch_request = self._serve_restore_fetch
+        self.node.on_reclaim_request = self._serve_reclaim
         self.node.on_audit_request = self._serve_audit
         self.server.on_backup_matched = self._backup_matched
         self.server.on_audit_due = self._audit_due
@@ -121,7 +122,11 @@ class ClientApp:
             f" in {recovery['elapsed_s']:.3f}s")
         self._audit_task = asyncio.create_task(
             self.engine.audit_scheduler())
-        self._monitor_task = asyncio.create_task(self.monitor.run())
+        self._monitor_task = asyncio.create_task(
+            # the durability sweep doubles as the receiver-side TTL
+            # janitor's clock, so abandoned partials age out without a
+            # restart (engine.expire_partials also runs in recovery)
+            self.monitor.run(janitor=self.engine.expire_partials))
         if self._status_port_req is not None:
             from .obs.expo import StatusServer
             self._status_server = StatusServer(
@@ -190,6 +195,11 @@ class ClientApp:
         self.messenger.log(
             f"served {sent} fetched item(s) back to "
             f"{bytes(source).hex()[:8]}")
+
+    async def _serve_reclaim(self, source: bytes, transport) -> None:
+        freed = await self.node.serve_reclaim(source, transport)
+        self.messenger.log(
+            f"reclaimed {freed} byte(s) for {bytes(source).hex()[:8]}")
 
     async def _serve_audit(self, source: bytes, transport) -> None:
         answered = await self.node.serve_audit(source, transport,

@@ -215,6 +215,27 @@ DURABILITY_AUDIT_MAX_AGE_S = 4 * AUDIT_INTERVAL_S
 # day will restart the transfer from its own resume handshake anyway.
 PARTIAL_STORE_TTL_S = 24 * 3600.0
 
+# --- snapshot lifecycle / GC (engine.run_gc, docs/lifecycle.md; no
+# reference equivalent — the reference is append-only) ------------------------
+# Default retention policy recorded into fresh stores.  keep-all keeps
+# every snapshot (the pre-lifecycle behavior); operators narrow it to
+# comma-separated keep-last:N / keep-daily:N rules.
+RETENTION_DEFAULT = "keep-all"
+# A packfile whose live-byte fraction (bytes still referenced by some
+# retained snapshot / total payload bytes) drops below this is sparse:
+# GC pulls it back, extracts the live blobs, and re-packs them into
+# fresh packfiles.  At/above the threshold the dead bytes ride along —
+# compaction I/O costs more than the space it would free.
+GC_COMPACT_OCCUPANCY = 0.5
+# Holder-side RECLAIM rate limit, same posture as the restore throttle:
+# one reclaim service per peer per interval, so a buggy (or hostile)
+# peer cannot grind a holder's disk with delete storms.
+RECLAIM_MIN_INTERVAL_S = 5.0
+# Max file ids accepted in one RECLAIM body (mirrors the restore-fetch
+# wants cap): bounds the per-request unlink loop and the ack's freed-
+# bytes accounting.
+RECLAIM_MAX_ITEMS = 4096
+
 # --- scale-out coordination plane (net/serverstore.py, net/matchmaking.py,
 # docs/server.md; no reference equivalent — the reference is one process
 # over one Postgres) ----------------------------------------------------------

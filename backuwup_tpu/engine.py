@@ -67,8 +67,9 @@ from .obs import trace as obs_trace
 from .ops.backend import ChunkerBackend, select_backend
 from .snapshot.blob_index import BlobIndex, ChallengeTable
 from .snapshot.packer import DirPacker
-from .snapshot.packfile import PackfileReader, PackfileWriter
-from .store import EVENT_BACKUP, EVENT_REPAIR, EVENT_RESTORE_REQUEST, Store
+from .snapshot.packfile import PackfileReader, PackfileWriter, packfile_path
+from .store import (EVENT_BACKUP, EVENT_GC, EVENT_REPAIR,
+                    EVENT_RESTORE_REQUEST, Store)
 from .utils import faults, retry, tracing
 
 
@@ -99,6 +100,22 @@ _RECOVERY_ITEMS = obs_metrics.counter(
 _RECOVERY_SECONDS = obs_metrics.histogram(
     "bkw_recovery_seconds", "Startup recovery sweep wall time")
 
+_GC_RUNS = obs_metrics.counter(
+    "bkw_gc_runs_total", "GC runs by outcome", ("outcome",))
+_GC_BYTES_RECLAIMED = obs_metrics.counter(
+    "bkw_gc_bytes_reclaimed_total",
+    "Bytes GC retired, by where they lived (remote placements vs local"
+    " packfiles)", ("kind",))
+_GC_PACKFILES_DROPPED = obs_metrics.counter(
+    "bkw_gc_packfiles_dropped_total",
+    "Packfiles GC retired with zero live bytes")
+_GC_PACKFILES_COMPACTED = obs_metrics.counter(
+    "bkw_gc_packfiles_compacted_total",
+    "Sparse packfiles GC pulled back and re-packed")
+_GC_SNAPSHOTS_PRUNED = obs_metrics.counter(
+    "bkw_gc_snapshots_pruned_total",
+    "Snapshots retention marked dead")
+
 # Crash-matrix seams around the engine's multi-step placement commits
 _CP_PLACE_PRE = faults.register_crash_site("placement.insert.pre")
 _CP_PLACE_POST = faults.register_crash_site("placement.insert.post")
@@ -106,6 +123,19 @@ _CP_STRIPE_PRE = faults.register_crash_site("stripe.finish.pre")
 _CP_STRIPE_POST = faults.register_crash_site("stripe.finish.post")
 _CP_REHOME_PRE = faults.register_crash_site("repair.rehome.pre")
 _CP_REHOME_POST = faults.register_crash_site("repair.rehome.post")
+# GC's multi-step seams (docs/lifecycle.md): prune commit, sweep-plan
+# manifest, compaction seal, make-before-break placement swap, reclaim
+# retire — each bracketed pre/post like the placement seams above
+_CP_GC_PRUNE_PRE = faults.register_crash_site("gc.prune.pre")
+_CP_GC_PRUNE_POST = faults.register_crash_site("gc.prune.post")
+_CP_GC_SWEEP_PRE = faults.register_crash_site("gc.sweep.pre")
+_CP_GC_SWEEP_POST = faults.register_crash_site("gc.sweep.post")
+_CP_GC_SEAL_PRE = faults.register_crash_site("gc.compact.seal.pre")
+_CP_GC_SEAL_POST = faults.register_crash_site("gc.compact.seal.post")
+_CP_GC_SWAP_PRE = faults.register_crash_site("gc.swap.pre")
+_CP_GC_SWAP_POST = faults.register_crash_site("gc.swap.post")
+_CP_GC_RECLAIM_PRE = faults.register_crash_site("gc.reclaim.pre")
+_CP_GC_RECLAIM_POST = faults.register_crash_site("gc.reclaim.post")
 
 
 def _registry_stage_sums() -> Dict[str, float]:
@@ -360,7 +390,15 @@ class Engine:
             "stripes_underplaced": 0,
             "staging_cleared": 0,
             "partials_expired": 0,
+            "gc_rolled_back": 0,
+            "gc_rolled_forward": 0,
         }
+
+        # interrupted GC first: roll the swap forward or back BEFORE the
+        # leftover-packfile walk below, so a rolled-back compacted
+        # packfile is gone before adoption could mistake it for a normal
+        # pending backup packfile (docs/lifecycle.md GC state machine)
+        self._recover_gc_state(rep)
 
         # orphaned .tmp files from crashed tmp+replace commits
         pack_base = self._pack_dir()
@@ -393,9 +431,29 @@ class Engine:
                 except OSError:
                     pass
                 self.index.forget_packfiles([pid])
+                # its audit tables go with it: challenge state for a
+                # dead packfile must not resurrect it as auditable
+                self.challenge_tables.forget([pid])
                 rep["packfiles_corrupt"] += 1
                 continue
             if bytes(pid) not in self.index.packfile_ids():
+                owned_elsewhere = entries and all(
+                    self.index.lookup(e.hash) not in (None, bytes(pid))
+                    for e in entries)
+                if owned_elsewhere:
+                    # a GC replacement whose plan was lost (crash before
+                    # the seal was recorded in gc_state): every blob is
+                    # still owned by the packfile it was compacted from,
+                    # so adopting this copy would double-place the data
+                    # and leave orphaned placements once it drained.
+                    # Drop it; the next GC re-compacts from the owners.
+                    try:
+                        path.unlink()
+                    except OSError:
+                        pass
+                    self.challenge_tables.forget([pid])
+                    rep["gc_rolled_back"] += 1
+                    continue
                 # the crash beat the index flush: the sealed file is the
                 # authoritative record (its header just AEAD-verified),
                 # so roll FORWARD — re-adopt its blobs into the index
@@ -449,18 +507,15 @@ class Engine:
         # stale staging trees: a crashed repair or restore re-pulls from
         # scratch, so half-staged bytes are only a disk leak
         for staging in (self.store.data_base / "repair_staging",
+                        self.store.data_base / "gc_staging",
                         self.store.restore_dir()):
             if staging.is_dir() and any(staging.iterdir()):
                 shutil.rmtree(staging, ignore_errors=True)
                 rep["staging_cleared"] += 1
 
-        # abandoned inbound partials (the receiver-side TTL janitor)
-        recv = self.store.data_base / "received_packfiles"
-        if recv.is_dir():
-            for peer_dir in recv.iterdir():
-                part = peer_dir / "partial"
-                if part.is_dir():
-                    rep["partials_expired"] += PartialStore(part).expire()
+        # abandoned inbound partials (the receiver-side TTL janitor —
+        # also run periodically on the durability sweep, app.py)
+        rep["partials_expired"] = self.expire_partials()
 
         # "reconciled" counts state this sweep actually changed; pending
         # backlog is observed, not reconciled (the drain owns it)
@@ -477,6 +532,497 @@ class Engine:
         obs_journal.emit("recovery_report", **rep)
         self.last_recovery = rep
         return rep
+
+    def expire_partials(self) -> int:
+        """Receiver-side TTL janitor over every peer's partial-transfer
+        spill dir — shared by startup recovery and the periodic
+        durability sweep (app.py), so abandoned partials age out even on
+        long-lived processes that never restart."""
+        expired = 0
+        recv = self.store.data_base / "received_packfiles"
+        if recv.is_dir():
+            for peer_dir in recv.iterdir():
+                part = peer_dir / "partial"
+                if part.is_dir():
+                    expired += PartialStore(part).expire()
+        return expired
+
+    def _recover_gc_state(self, rep: Dict) -> None:
+        """Resolve a GC interrupted mid-flight (docs/lifecycle.md).
+
+        The swap's durable index flush is the commit point.  After a
+        crash the freshly-loaded index tells us which side we are on:
+        an old packfile id still mapped means the flush never landed —
+        roll BACK (the compacted replacements are re-derivable, the old
+        placements are still authoritative); an old id gone (its hashes
+        re-homed or tombstoned) means it did — roll FORWARD by re-running
+        the idempotent swap body so retire/reclaim bookkeeping finishes.
+        Runs before the leftover-packfile walk so a rolled-back
+        replacement is deleted before adoption could mistake it for a
+        pending backup packfile.
+        """
+        state = self.store.get_gc_state()
+        if not state:
+            return
+        if state.get("phase") == "reclaim":
+            # everything durable already committed; the reclaim_backlog
+            # table carries the best-effort tail — the next GC drains it
+            self.store.set_gc_state(None)
+            rep["gc_rolled_forward"] += 1
+            return
+        new_map = {bytes.fromhex(h): [bytes.fromhex(x)
+                                      for x in info["hashes"]]
+                   for h, info in state.get("new", {}).items()}
+        old_pids = [bytes.fromhex(h)
+                    for h in list(state.get("drop", []))
+                    + list(state.get("compact", []))]
+        ids = self.index.packfile_ids()
+        committed = (bool(set(new_map) & ids)
+                     or any(pid not in ids for pid in old_pids))
+        if committed and old_pids:
+            self._gc_apply_swap(old_pids, new_map)
+            # the interrupted run died before its accounting: attribute
+            # the retired packfiles here (bytes are counted inside the
+            # idempotent swap body itself)
+            if state.get("drop"):
+                _GC_PACKFILES_DROPPED.inc(len(state["drop"]))
+            if state.get("compact"):
+                _GC_PACKFILES_COMPACTED.inc(len(state["compact"]))
+            self.store.set_gc_state(None)
+            rep["gc_rolled_forward"] += 1
+            return
+        # pre-commit crash: the replacements never entered the index, so
+        # delete their local files and audit tables, and hand any shards
+        # already placed (make-before-break places FIRST) to the reclaim
+        # backlog — the holders' bytes must not leak
+        for npid in new_map:
+            try:
+                packfile_path(self._pack_dir(), npid).unlink()
+            except OSError:
+                pass
+            self.challenge_tables.forget([npid])
+            for peer, size, idx in self.store.placements_for_packfile(npid):
+                fid = rs_stripe.shard_id(npid, idx) if idx >= 0 \
+                    else bytes(npid)
+                kind = wire.FileInfoKind.SHARD if idx >= 0 \
+                    else wire.FileInfoKind.PACKFILE
+                self.store.queue_reclaim(fid, peer, int(kind), size)
+                self.store.retire_placement(npid, peer)
+        self.store.set_gc_state(None)
+        rep["gc_rolled_back"] += 1
+
+    # --- snapshot lifecycle: retention, GC, compaction, reclaim -------------
+    # (docs/lifecycle.md)
+
+    async def run_gc(self, policy: Optional[str] = None) -> Dict:
+        """Retention prune + mark-and-sweep GC + make-before-break
+        compaction + remote reclaim, one serialized pass.
+
+        Phases: *prune* (retention marks snapshots dead — lineage rows
+        stay, data is untouched); *mark* (live set = blobs reachable
+        from any retained snapshot's manifest); *sweep* (classify
+        packfiles: zero live bytes drop, occupancy below
+        ``GC_COMPACT_OCCUPANCY`` compacts; persist the plan); *compact*
+        (pull sparse packfiles back k-of-n, re-pack only the live blobs,
+        fresh challenge tables); *place* (new packfiles ride the normal
+        RS send pipeline and must be acked BEFORE anything retires);
+        *swap* (one durable index flush forgets old packfiles, finalizes
+        replacements, tombstones dead blobs); *reclaim* (signed RECLAIM
+        requests tell holders to drop superseded bytes, best-effort —
+        the backlog table persists what did not drain).
+
+        Holds the backup/restore exclusivity lock; at no instant may the
+        invariant monitor see a retained snapshot's bytes unprotected.
+        """
+        if self._exclusive.locked():
+            _BUSY_REJECTS.inc(op="gc")
+            raise EngineError("a backup or restore is already running")
+        async with self._exclusive:
+            with obs_trace.span("engine.gc"):
+                try:
+                    report = await self._run_gc_locked(policy)
+                except BaseException:
+                    _GC_RUNS.inc(outcome="failed")
+                    raise
+        _GC_RUNS.inc(outcome="ok")
+        return report
+
+    async def _run_gc_locked(self, policy: Optional[str]) -> Dict:
+        t0 = time.monotonic()
+        report: Dict = {
+            "snapshots_pruned": 0, "packfiles_dropped": 0,
+            "packfiles_compacted": 0, "blobs_dropped": 0,
+            "bytes_reclaimed_remote": 0, "bytes_reclaimed_local": 0,
+            "placements_retired": 0, "reclaims_sent": 0,
+            "reclaim_bytes_freed": 0, "refused": "",
+        }
+
+        # prune: one sqlite commit flips pruned_at on the victims
+        faults.crashpoint(_CP_GC_PRUNE_PRE)
+        pruned = await self._blocking(self.store.apply_retention, policy)
+        faults.crashpoint(_CP_GC_PRUNE_POST)
+        report["snapshots_pruned"] = len(pruned)
+        if pruned:
+            _GC_SNAPSHOTS_PRUNED.inc(len(pruned))
+            self._log(f"gc: retention pruned {len(pruned)} snapshot(s)")
+
+        # refuse to collect what we cannot reason about: no retained
+        # snapshot at all, or retained snapshots predating the manifest
+        # plane (their reachable set is unknowable — dropping anything
+        # could tear them)
+        retained = await self._blocking(self.store.retained_snapshots)
+        unmanifested = await self._blocking(
+            self.store.snapshots_without_manifest)
+        if not retained or unmanifested:
+            report["refused"] = (
+                "no retained snapshots recorded"
+                if not retained else
+                f"{len(unmanifested)} retained snapshot(s) have no"
+                " manifest (pre-lifecycle backups)")
+            self._log(f"gc: refused: {report['refused']}")
+            # a previous run's committed reclaims still deserve a drain
+            report.update(await self._drain_reclaims())
+            return self._gc_finish(report, t0)
+
+        # mark + sweep classification (pure compute over two DB scans)
+        live = await self._blocking(self.store.live_blobs)
+        known = await self._blocking(self.store.manifest_blobs)
+        drop, compact = self._gc_classify(live, known)
+
+        # sweep-plan manifest: the roll-forward/roll-back record
+        faults.crashpoint(_CP_GC_SWEEP_PRE)
+        await self._blocking(self.store.set_gc_state, {
+            "phase": "sweep",
+            "drop": [p.hex() for p in drop],
+            "compact": [p.hex() for p in compact],
+            "new": {}})
+        faults.crashpoint(_CP_GC_SWEEP_POST)
+
+        # compact: pull the sparse packfiles' bytes back and re-pack
+        # only the live blobs into fresh packfiles (fresh ids, fresh
+        # challenge tables).  A packfile whose bytes cannot be staged is
+        # left exactly as it was — never break what we could not rebuild.
+        new_map: Dict[bytes, dict] = {}
+        staging = self.store.data_base / "gc_staging"
+        try:
+            if compact:
+                staged = await self._gc_stage_packfiles(compact, staging)
+                short = [p for p in compact if p not in staged]
+                if short:
+                    self._log(f"gc: {len(short)} packfile(s) not stageable"
+                              " this run; left in place")
+                    compact = [p for p in compact if p in staged]
+                if compact:
+                    new_map = await self._blocking(
+                        self._gc_repack, compact, staged, live)
+            # compaction seal commit: the plan now names the replacements
+            faults.crashpoint(_CP_GC_SEAL_PRE)
+            await self._blocking(self.store.set_gc_state, {
+                "phase": "place",
+                "drop": [p.hex() for p in drop],
+                "compact": [p.hex() for p in compact],
+                "new": {pid.hex(): {"hashes": [h.hex() for h in info["hashes"]],
+                                    "size": info["size"]}
+                        for pid, info in new_map.items()}})
+            faults.crashpoint(_CP_GC_SEAL_POST)
+        finally:
+            await self._blocking(
+                lambda: shutil.rmtree(staging, ignore_errors=True))
+
+        # place (make BEFORE break): the replacements travel the normal
+        # RS send pipeline — striped, per-shard challenge tables, local
+        # copies unlinked only on the holders' signed acks
+        if new_map:
+            orch = self.orchestrator = Orchestrator()
+            orch.set_buffer(self._buffer_bytes())
+            orch.packing_completed = True
+            estimate = max(sum(i["size"] for i in new_map.values()), 1)
+            await self._send_loop(orch, estimate)
+
+        # swap: ONE durable commit breaks the old placements' authority
+        faults.crashpoint(_CP_GC_SWAP_PRE)
+        swap = await self._blocking(
+            self._gc_apply_swap, drop + compact,
+            {pid: info["hashes"] for pid, info in new_map.items()})
+        # accounting rides the commit: the swap body counted the bytes,
+        # the packfile counts land here, both BEFORE the post-swap seam
+        # so a crash there does not lose the run's evidence
+        if drop:
+            _GC_PACKFILES_DROPPED.inc(len(drop))
+        if compact:
+            _GC_PACKFILES_COMPACTED.inc(len(compact))
+        await self._blocking(self.store.set_gc_state, {"phase": "reclaim"})
+        faults.crashpoint(_CP_GC_SWAP_POST)
+        report["packfiles_dropped"] = len(drop)
+        report["packfiles_compacted"] = len(compact)
+        report["blobs_dropped"] = swap["blobs_dropped"]
+        report["placements_retired"] = swap["placements_retired"]
+        report["bytes_reclaimed_remote"] = swap["remote_bytes"]
+        report["bytes_reclaimed_local"] = swap["local_bytes"]
+        # manifest rows of pruned snapshots are only needed as the
+        # occupancy denominator until their blobs are collected
+        await self._blocking(self.store.drop_pruned_manifests)
+
+        # the swap's flush minted new index file(s); ship them before the
+        # old bytes retire, so a restore rebuilt purely from peers sees
+        # the post-GC map (tombstones included) rather than a stale map
+        # naming packfiles the holders are about to delete
+        await self._gc_ship_index()
+
+        # reclaim retire: best-effort; whatever does not drain stays in
+        # the backlog table for the next run (or recovery)
+        faults.crashpoint(_CP_GC_RECLAIM_PRE)
+        report.update(await self._drain_reclaims())
+        await self._blocking(self.store.set_gc_state, None)
+        faults.crashpoint(_CP_GC_RECLAIM_POST)
+        return self._gc_finish(report, t0)
+
+    async def _gc_ship_index(self) -> None:
+        """Send index files past the watermark to a holder (the same
+        sequential protocol as a backup's tail).  Best-effort: with no
+        storage peers on record (offline runs, drop-only unit tests) the
+        next backup's send loop resumes from the watermark instead."""
+        if self.node is None or not self.store.find_peers_with_storage():
+            return
+        orch = self.orchestrator
+        orch.packing_completed = True
+        await self._send_index_files(orch, 1, 0)
+
+    def _gc_finish(self, report: Dict, t0: float) -> Dict:
+        report["elapsed_s"] = round(time.monotonic() - t0, 6)
+        self.store.add_event(EVENT_GC, {
+            k: report[k] for k in (
+                "snapshots_pruned", "packfiles_dropped",
+                "packfiles_compacted", "blobs_dropped",
+                "bytes_reclaimed_remote", "bytes_reclaimed_local",
+                "refused")})
+        obs_journal.emit("gc_report", **report)
+        self._log(
+            f"gc done: {report['packfiles_dropped']} dropped,"
+            f" {report['packfiles_compacted']} compacted,"
+            f" {report['bytes_reclaimed_remote']} remote byte(s) retired")
+        return report
+
+    def _gc_classify(self, live: Dict[bytes, int],
+                     known: Dict[bytes, int]) -> tuple:
+        """Split the index's packfiles into (drop, compact) lists.
+
+        Occupancy is judged on manifest-known payload bytes only: a blob
+        no manifest (retained OR pruned) names is invisible to GC — it
+        is never counted and never collected (the refuse-guard upstream
+        keeps pre-lifecycle retained data out of here entirely).
+        """
+        totals: Dict[bytes, int] = {}
+        alive: Dict[bytes, int] = {}
+        for h, pid in self.index.blob_map().items():
+            size = known.get(h)
+            if size is None:
+                continue
+            totals[pid] = totals.get(pid, 0) + size
+            if h in live:
+                alive[pid] = alive.get(pid, 0) + size
+        drop, compact = [], []
+        for pid, total in sorted(totals.items()):
+            live_bytes = alive.get(pid, 0)
+            if live_bytes == 0:
+                drop.append(pid)
+            elif total and live_bytes / total < defaults.GC_COMPACT_OCCUPANCY:
+                compact.append(pid)
+        return drop, compact
+
+    async def _gc_stage_packfiles(self, pids: list,
+                                  staging: Path) -> Dict[bytes, Path]:
+        """Obtain readable plaintext-decryptable bytes for each packfile
+        to compact: a copy still sitting in the local pack dir is used
+        directly (no pull); otherwise the k survivor shards come back
+        over the restore data plane (hedged, fastest-first) with a
+        whole-copy fetch as fallback, and stripes assemble in a private
+        staging tree.  Returns {packfile_id: base_dir for PackfileReader}.
+        """
+        staged: Dict[bytes, Path] = {}
+        need_pull = []
+        for pid in pids:
+            pid = bytes(pid)
+            if packfile_path(self._pack_dir(), pid).is_file():
+                staged[pid] = self._pack_dir()
+            else:
+                need_pull.append(pid)
+        if not need_pull:
+            return staged
+        await self._blocking(
+            lambda: shutil.rmtree(staging, ignore_errors=True))
+        staging.mkdir(parents=True, exist_ok=True)
+        writer = RestoreFilesWriter(self.store, base=staging)
+        sched = TransferScheduler(messenger=self.messenger,
+                                  peer_stats=self.peer_stats)
+        geom = self._stripe_geometry()
+        for pid in need_pull:
+            shard_map: Dict[int, tuple] = {}
+            whole = []
+            for peer, size, idx in self.store.placements_for_packfile(pid):
+                if idx < 0:
+                    whole.append((peer, size))
+                else:
+                    shard_map[idx] = (peer, size)
+            got = 0
+            k = geom[0] if geom is not None else defaults.RS_K
+            if shard_map:
+                got = await self._pull_stripe(pid, shard_map, writer, sched)
+            if got < min(k, len(shard_map)) or (not shard_map and whole):
+                for peer, size in whole:
+                    wants = [(wire.FileInfoKind.PACKFILE, pid)]
+                    res = await sched.submit_pull(
+                        peer, size,
+                        self._fetch_job(peer, wants, writer, size),
+                        label=f"gc:whole:{pid.hex()[:8]}")
+                    if res.ok:
+                        break
+        shard_root = staging / "shard"
+        if shard_root.is_dir():
+            await self._blocking(lambda: rs_stripe.assemble_tree(
+                shard_root, staging / "pack", self.backend))
+        for pid in need_pull:
+            if packfile_path(staging / "pack", pid).is_file():
+                staged[pid] = staging / "pack"
+        return staged
+
+    def _gc_repack(self, compact: list, staged: Dict[bytes, Path],
+                   live: Dict[bytes, int]) -> Dict[bytes, dict]:
+        """Re-pack the live blobs of the sparse packfiles into fresh
+        packfiles (executor thread).  The replacements get challenge
+        tables built from their local ciphertext at seal time — the same
+        audit seam a backup seal uses — but are NOT finalized into the
+        blob index yet: that happens atomically in the swap, after the
+        new placements are acked.  Returns
+        {new_packfile_id: {"hashes": [...], "size": int}}.
+        """
+        new_map: Dict[bytes, dict] = {}
+
+        def on_sealed(pid, path, hashes, size):
+            try:
+                if not self.challenge_tables.has(pid):
+                    self.challenge_tables.save(
+                        pid, build_challenge_table(
+                            self.backend, path.read_bytes(),
+                            count=defaults.AUDIT_CHALLENGES_PER_PACKFILE))
+            except Exception as e:
+                self._log(f"gc: challenge table for "
+                          f"{bytes(pid).hex()[:8]} failed: {e}")
+            new_map[bytes(pid)] = {
+                "hashes": [bytes(h) for h in hashes], "size": int(size)}
+
+        owner = self.index.blob_map()
+        writer = PackfileWriter(self.keys, self._pack_dir(),
+                                on_packfile=on_sealed)
+        try:
+            for old_pid in compact:
+                old_pid = bytes(old_pid)
+                reader = PackfileReader(self.keys, staged[old_pid])
+                for blob in reader.iter_blobs(old_pid):
+                    h = bytes(blob.hash)
+                    # keep a blob only if it is live AND this packfile is
+                    # its one committed home — a hash owned elsewhere
+                    # would otherwise be duplicated
+                    if h in live and owner.get(h) == old_pid:
+                        writer.add_blob(blob)
+            writer.flush()
+        finally:
+            writer.shutdown()
+        return new_map
+
+    def _gc_apply_swap(self, old_pids: list,
+                       new_map: Dict[bytes, list]) -> Dict[str, int]:
+        """The break half of make-before-break, idempotent (the recovery
+        roll-forward re-runs it verbatim): forget the old packfiles,
+        finalize the replacements, tombstone the blobs nothing names any
+        more, and flush — ONE durable index commit.  Only then do the
+        old audit tables, local copies, and placement rows retire, each
+        superseded remote file going onto the reclaim backlog.
+        """
+        lost = self.index.forget_packfiles(old_pids)
+        for npid, hashes in new_map.items():
+            self.index.finalize_packfile(npid, hashes)
+        dead = sorted(h for h in lost if self.index.lookup(h) is None)
+        self.index.record_tombstones(dead)
+        self.index.flush()  # <- the commit point
+        self.challenge_tables.forget(old_pids)
+        local_bytes = 0
+        remote_bytes = 0
+        retired = 0
+        for pid in old_pids:
+            pid = bytes(pid)
+            path = packfile_path(self._pack_dir(), pid)
+            try:
+                local_bytes += path.stat().st_size
+                path.unlink()
+            except OSError:
+                pass
+            for peer, size, idx in self.store.placements_for_packfile(pid):
+                fid = rs_stripe.shard_id(pid, idx) if idx >= 0 else pid
+                kind = wire.FileInfoKind.SHARD if idx >= 0 \
+                    else wire.FileInfoKind.PACKFILE
+                # queue-then-retire: a crash between the two re-queues on
+                # the next pass (INSERT OR IGNORE), never leaks the row
+                self.store.queue_reclaim(fid, peer, int(kind), size)
+                retired += self.store.retire_placement(pid, peer)
+                remote_bytes += size
+        # counted here, not in the caller, so a recovery roll-forward's
+        # re-run attributes whatever it finishes retiring; a re-run over
+        # already-retired state finds zero bytes, so no double count
+        if remote_bytes:
+            _GC_BYTES_RECLAIMED.inc(remote_bytes, kind="remote")
+        if local_bytes:
+            _GC_BYTES_RECLAIMED.inc(local_bytes, kind="local")
+        return {"blobs_dropped": len(dead),
+                "placements_retired": retired,
+                "remote_bytes": remote_bytes,
+                "local_bytes": local_bytes}
+
+    async def _drain_reclaims(self) -> Dict[str, int]:
+        """Drain the reclaim backlog: one signed RECLAIM request per
+        holder (batched to ``RECLAIM_MAX_ITEMS``), crediting our local
+        view of the peer's quota and clearing rows only on its ack.
+        Failures are isolated per peer; unreachable holders keep their
+        rows for the next drain."""
+        backlog = await self._blocking(self.store.reclaim_backlog)
+        sent = 0
+        freed = 0
+        by_peer: Dict[bytes, list] = {}
+        for fid, peer, kind, size in backlog:
+            by_peer.setdefault(peer, []).append((fid, kind, size))
+        for peer, items in sorted(by_peer.items()):
+            if self.node is None:
+                break
+            for start in range(0, len(items), defaults.RECLAIM_MAX_ITEMS):
+                batch = items[start:start + defaults.RECLAIM_MAX_ITEMS]
+                try:
+                    t = await self.node.connect(
+                        peer, wire.RequestType.RECLAIM,
+                        timeout=self._dial_budget(peer))
+                except (P2PError, ServerError, OSError,
+                        asyncio.TimeoutError) as e:
+                    self._log(f"gc: reclaim dial {peer.hex()[:8]}"
+                              f" failed: {e}")
+                    break
+                try:
+                    freed_now = await self.node.request_reclaim(
+                        t, [(wire.FileInfoKind(kind), fid)
+                            for fid, kind, _s in batch])
+                except (P2PError, OSError, asyncio.TimeoutError) as e:
+                    self._log(f"gc: reclaim to {peer.hex()[:8]}"
+                              f" failed: {e}")
+                    break
+                finally:
+                    await t.close()
+                total = sum(s for _f, _k, s in batch)
+                await self._blocking(
+                    self.store.credit_peer_transmitted, peer, total)
+                for fid, _kind, _s in batch:
+                    await self._blocking(
+                        self.store.clear_reclaim, fid, peer)
+                sent += len(batch)
+                freed += freed_now
+        return {"reclaims_sent": sent, "reclaim_bytes_freed": freed}
 
     # --- backup ------------------------------------------------------------
 
@@ -509,6 +1055,11 @@ class Engine:
         self._log(f"backup started, estimated {estimate} bytes")
         self._progress(size_estimate=estimate, running=True)
         snapshot_holder: dict = {}
+        # the snapshot's reachable-blob manifest, collected as the packer
+        # visits every blob (duplicates included) — GC's mark phase is a
+        # join against this, persisted atomically with the lineage row.
+        # Written only from the single pack thread, read after it joins.
+        manifest: Dict[bytes, int] = {}
         # contextvars do not cross run_in_executor: hand the backup's
         # trace id to the pack thread so its spans journal under it
         backup_tid = obs_trace.current_trace_id()
@@ -521,7 +1072,8 @@ class Engine:
             packer = DirPacker(self.backend, writer, self.index,
                                progress=self._pack_progress,
                                should_pause=orch.block_if_paused,
-                               dedup_index=self.device_dedup)
+                               dedup_index=self.device_dedup,
+                               on_blob=lambda h, s: manifest.setdefault(h, s))
             try:
                 with obs_trace.bind(backup_tid), \
                         tracing.span("engine.pack"), \
@@ -550,6 +1102,14 @@ class Engine:
             raise EngineError("send pipeline cancelled")
         snapshot = snapshot_holder["hash"]
         self.last_pack_stats = snapshot_holder["stats"]
+        # lineage + manifest commit (one store transaction): parent is
+        # the previous retained head, so prune/GC can reason about the
+        # chain (docs/lifecycle.md)
+        parent = self.store.latest_snapshot()
+        await self._blocking(
+            self.store.record_snapshot, snapshot,
+            None if parent is None else parent.hash,
+            snapshot_holder["stats"].bytes_read, list(manifest.items()))
         await self.server.backup_done(snapshot)
         self.store.add_event(EVENT_BACKUP, {
             "size": snapshot_holder["stats"].bytes_read,
@@ -1318,6 +1878,9 @@ class Engine:
                 orphaned[pidb] = orphaned.get(pidb, 0) + sum(
                     s for _, s in stripe_lost[pidb].values())
         lost_hashes = self.index.forget_packfiles(orphaned)
+        # the dead packfiles' audit tables go with them (whole-file AND
+        # per-shard): challenge state must not outlive the data it names
+        self.challenge_tables.forget(orphaned)
         bytes_lost = sum(orphaned.values()) + sum(
             s for pidb, lm in stripe_lost.items() if pidb not in orphaned
             for _, s in lm.values())

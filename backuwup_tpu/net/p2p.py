@@ -62,6 +62,12 @@ _STALLS = obs_metrics.counter(
 _PARTIALS_EXPIRED = obs_metrics.counter(
     "bkw_partials_expired_total",
     "Abandoned partial transfers expired by the receiver-side TTL janitor")
+_RECLAIM_REQUESTS = obs_metrics.counter(
+    "bkw_reclaim_requests_total",
+    "RECLAIM requests served (holder side), by outcome", ("outcome",))
+_RECLAIM_BYTES_FREED = obs_metrics.counter(
+    "bkw_reclaim_bytes_freed_total",
+    "Bytes a holder deleted (and credited back) while serving RECLAIMs")
 
 # Crash-matrix seam around the receiver's partial-stage commit
 _CP_PARTIAL_PRE = faults.register_crash_site("partial.sink.pre")
@@ -802,6 +808,7 @@ class P2PNode:
         self.on_restore_request: Optional[Callable] = None
         self.on_restore_fetch_request: Optional[Callable] = None
         self.on_audit_request: Optional[Callable] = None
+        self.on_reclaim_request: Optional[Callable] = None
         server_client.on_incoming_p2p = self._handle_incoming
         server_client.on_finalize_p2p = self._handle_finalize
 
@@ -911,6 +918,9 @@ class P2PNode:
                 elif request_type == wire.RequestType.AUDIT:
                     if self.on_audit_request is not None:
                         await self.on_audit_request(source, transport)
+                elif request_type == wire.RequestType.RECLAIM:
+                    if self.on_reclaim_request is not None:
+                        await self.on_reclaim_request(source, transport)
             finally:
                 done.set()
                 await transport.close()
@@ -1001,6 +1011,89 @@ class P2PNode:
                 await transport.send_file(data, kind, bytes(fid))
                 sent += 1
         return sent
+
+    # --- reclaim serving (GC's make-before-break tail, docs/lifecycle.md) ---
+
+    async def request_reclaim(self, transport: Transport, items,
+                              timeout: Optional[float] = None) -> int:
+        """Owner side: ask the connected holder to delete the named
+        superseded items.  ``items`` iterates ``(FileInfoKind, file_id)``
+        pairs; returns the bytes the holder reports freed.  Correlation
+        is the CHALLENGE/PROOF idiom — the ack echoes our sequence."""
+        seq = transport.seq
+        transport.seq += 1
+        body = wire.P2PBody(
+            kind=wire.P2PBodyKind.RECLAIM_REQUEST,
+            header=wire.P2PHeader(sequence_number=seq,
+                                  session_nonce=transport.session_nonce),
+            wants=tuple((wire.FileInfoKind(k), bytes(i))
+                        for k, i in items))
+        await transport.send_body(body)
+        reply = await transport.recv_body(
+            defaults.AUDIT_PROOF_TIMEOUT_S if timeout is None else timeout)
+        if reply.kind != wire.P2PBodyKind.RECLAIM_ACK \
+                or reply.header.sequence_number != seq:
+            raise P2PError("expected a RECLAIM_ACK echoing our sequence")
+        return int(reply.offset)
+
+    async def serve_reclaim(self, peer_id: bytes,
+                            transport: Transport) -> int:
+        """Serve one RECLAIM_REQUEST: delete the named items the signed
+        requester itself stored with us, credit the freed bytes back
+        against its quota, and ack with the byte count.
+
+        Deletion scope is bounded by identity: paths resolve strictly
+        under ``received_dir(peer_id)`` via the same ``_dest`` mapping
+        the receive path uses, so a peer can only ever reclaim its OWN
+        placements.  Unknown ids are skipped, not errors — the owner
+        retries from its persisted backlog and an already-deleted file
+        simply contributes zero bytes (idempotent re-delivery)."""
+        peer_hex = bytes(peer_id).hex()
+        last = self.store.last_event_time(f"reclaim_served:{peer_hex}")
+        if last is not None and \
+                time.time() - last < defaults.RECLAIM_MIN_INTERVAL_S:
+            _RECLAIM_REQUESTS.inc(outcome="throttled")
+            raise P2PError("reclaim request throttled")
+        self.store.add_event(f"reclaim_served:{peer_hex}", {})
+        writer = ReceivedFilesWriter(self.store, peer_id)
+        body = await transport.recv_body(defaults.AUDIT_PROOF_TIMEOUT_S)
+        if body.kind != wire.P2PBodyKind.RECLAIM_REQUEST:
+            _RECLAIM_REQUESTS.inc(outcome="bad_body")
+            raise P2PError(
+                "expected a RECLAIM_REQUEST body on a reclaim connection")
+        if len(body.wants) > defaults.RECLAIM_MAX_ITEMS:
+            _RECLAIM_REQUESTS.inc(outcome="too_many")
+            raise P2PError("too many items in one reclaim request")
+        loop = asyncio.get_running_loop()
+
+        def _unlink() -> int:
+            freed = 0
+            for kind, fid in body.wants:
+                path = writer._dest(kind, fid)
+                try:
+                    size = path.stat().st_size
+                    path.unlink()
+                    freed += size
+                except OSError:
+                    continue  # unknown or already gone: zero bytes
+            return freed
+
+        freed = await loop.run_in_executor(None, _unlink)
+        if freed:
+            # the deleted bytes stop counting against the peer's quota
+            # (clamped: a replayed delete cannot mint free storage)
+            self.store.credit_peer_received(peer_id, freed)
+            _RECLAIM_BYTES_FREED.inc(freed)
+        _RECLAIM_REQUESTS.inc(outcome="ok")
+        reply = wire.P2PBody(
+            kind=wire.P2PBodyKind.RECLAIM_ACK,
+            header=wire.P2PHeader(
+                sequence_number=body.header.sequence_number,
+                session_nonce=transport.session_nonce),
+            acked_sequence=body.header.sequence_number,
+            offset=freed)
+        await transport.send_body(reply)
+        return freed
 
     # --- audit serving (prover side of the storage attestation) ------------
 

@@ -299,18 +299,31 @@ class InvariantMonitor:
 
     # --- background cadence ------------------------------------------------
 
-    async def run(self, interval_s: Optional[float] = None) -> None:
+    async def run(self, interval_s: Optional[float] = None,
+                  janitor=None) -> None:
         """Sweep-then-sleep forever (cancel to stop); the ClientApp
         background task.  Sweeping FIRST makes health current within one
-        interval of any state change."""
+        interval of any state change.  ``janitor`` (a blocking callable,
+        e.g. ``Engine.expire_partials``) piggybacks on the same cadence
+        so receiver-side TTL hygiene runs on live processes too, not
+        only inside startup recovery — it runs on the executor and its
+        failures are contained like a sweep bug's."""
         interval = defaults.DURABILITY_SWEEP_INTERVAL_S \
             if interval_s is None else interval_s
+        loop = asyncio.get_running_loop()
         while True:
             try:
                 self.sweep()
             except Exception as e:  # a sweep bug must not kill the app
                 obs_journal.emit("durability_sweep_error", client=self.client,
                                  error=repr(e)[:200])
+            if janitor is not None:
+                try:
+                    await loop.run_in_executor(None, janitor)
+                except Exception as e:
+                    obs_journal.emit("durability_sweep_error",
+                                     client=self.client,
+                                     error=repr(e)[:200])
             await asyncio.sleep(interval)
 
 

@@ -42,6 +42,14 @@ through a scripted sequence of timed phases:
                discarded, directories re-opened) so the startup recovery
                sweep reconciles, then a re-run backup must complete and
                a second ``recover()`` must reconcile zero items
+``gc``         snapshot lifecycle: mutate the corpus so a retention
+               prune (keep-last:1) creates dead blobs, back up, then
+               collect.  With ``sites``, per armed GC seam the
+               ``run_gc`` dies mid-commit, the client restarts, and the
+               re-run + recovery must converge (same crash-facts shape
+               as ``crash``, so the ``recovery_clean`` gate applies);
+               without sites, GC races a concurrent backup + restore on
+               the exclusivity lock while still reclaiming bytes
 =============  ============================================================
 
 Everything is seeded (fault plane, corpus bytes, victim choice), so a
@@ -147,6 +155,7 @@ _PATCH = {
     "DIAL_RETRY_BASE_S": 0.05,
     "DIAL_RETRY_CAP_S": 0.2,
     "DURABILITY_SWEEP_INTERVAL_S": 0.5,
+    "RECLAIM_MIN_INTERVAL_S": 0.0,
 }
 
 
@@ -328,6 +337,14 @@ class ScenarioHarness:
     def _grow(self) -> None:
         self._grown += 1
         self._write_corpus(f"grow{self._grown}")
+
+    def _mutate_corpus(self) -> None:
+        """Rewrite every other corpus file in place.  The old contents
+        then live only in pre-mutation snapshots, so a retention prune
+        turns them into dead blobs — GC's raw material."""
+        files = sorted(p for p in self.src.rglob("*.bin") if p.is_file())
+        for p in files[::2]:
+            p.write_bytes(self.rng.randbytes(p.stat().st_size))
 
     async def _retry_busy(self, op, pause: float = 0.05):
         """Spin on the engine exclusivity lock — the race phase's whole
@@ -619,6 +636,72 @@ class ScenarioHarness:
             })
         self.facts["source_digest"] = _tree_digest(self.src)
 
+    async def _phase_gc(self, ph: Phase) -> None:
+        """Snapshot lifecycle under pressure (docs/lifecycle.md).
+
+        Both modes start by mutating the corpus and backing it up, so a
+        ``keep-last:1`` prune has a victim snapshot whose exclusive
+        blobs are provably dead — the bytes-reclaimed gates cannot pass
+        vacuously.  ``sites`` mode then walks the GC crash matrix like
+        :meth:`_phase_crash` walks the backup's; plain mode races GC
+        against a concurrent backup + restore on the exclusivity lock.
+        """
+        self.a.store.set_retention_policy("keep-last:1")
+        gcs = self.facts.setdefault("gc_reports", [])
+        if ph.sites:
+            crashes = self.facts.setdefault("crash_sites", [])
+            for site in ph.sites:
+                self._mutate_corpus()
+                snapshot = await asyncio.wait_for(
+                    self._retry_busy(lambda: self.a.backup()), 180)
+                if not snapshot:
+                    raise ScenarioError(
+                        f"gc setup backup before {site} returned"
+                        " no snapshot")
+                self.facts["backups"] += 1
+                self.plane.arm_crash(site)
+                try:
+                    await asyncio.wait_for(self.a.engine.run_gc(), 180)
+                    raise ScenarioError(
+                        f"armed crash at {site} never fired")
+                except faults.CrashInjected as e:
+                    if e.site != site:
+                        raise ScenarioError(
+                            f"crash fired at {e.site}, armed {site}")
+                report = await self._restart_client()
+                # the re-run must converge from whatever the recovery
+                # sweep rolled forward or back
+                gcs.append(await asyncio.wait_for(
+                    self._retry_busy(lambda: self.a.engine.run_gc()), 180))
+                again = await self.a.engine.recover()
+                sweep = self.monitor.sweep()
+                crashes.append({
+                    "site": site,
+                    "reconciled": report["reconciled"],
+                    "backlog": report["packfiles_pending"]
+                    + report["stripes_underplaced"],
+                    "idempotent": again["reconciled"] == 0,
+                    "violations_after": len(sweep.violations),
+                })
+        else:
+            self._mutate_corpus()
+            snapshot = await asyncio.wait_for(
+                self._retry_busy(lambda: self.a.backup()), 180)
+            if not snapshot:
+                raise ScenarioError("gc setup backup returned no snapshot")
+            self.facts["backups"] += 1
+            self._restores += 1
+            dest = self.workdir / f"gc_restore_{self._restores}"
+            _, _, gc_report = await asyncio.wait_for(asyncio.gather(
+                self._retry_busy(lambda: self.a.backup()),
+                self._retry_busy(lambda: self.a.engine.run_restore(dest)),
+                self._retry_busy(lambda: self.a.engine.run_gc()),
+            ), 240)
+            gcs.append(gc_report)
+            self.facts["backups"] += 1
+            self.facts["restores"] += 1
+        self.facts["source_digest"] = _tree_digest(self.src)
+
     # --- gates -------------------------------------------------------------
 
     def _assertions(self, error, counters) -> List[sc.Assertion]:
@@ -627,9 +710,12 @@ class ScenarioHarness:
         out = [A("phases_completed", error is None,
                  "" if error is None else f"{error[0]}: {error[1]}")]
         want_backups = sum(
-            _crash_count(p) if p.kind == "crash" else 1
+            _crash_count(p) if p.kind == "crash"
+            # gc: one setup backup per armed seam, or setup + racer
+            else (len(p.sites) if p.sites else 2) if p.kind == "gc"
+            else 1
             for p in spec.phases
-            if p.kind in ("backup", "churn", "race", "wan", "crash"))
+            if p.kind in ("backup", "churn", "race", "wan", "crash", "gc"))
         out.append(A("backups_completed",
                      facts["backups"] >= want_backups,
                      f"{facts['backups']}/{want_backups}"))
@@ -713,9 +799,11 @@ class ScenarioHarness:
             out.append(A("placement_demotion_recovered",
                          facts.get("wan_placement_recovered") is True,
                          "probation expiry re-admitted the slow holder"))
-        if any(p.kind == "crash" for p in spec.phases):
-            want = sum(_crash_count(p) for p in spec.phases
-                       if p.kind == "crash")
+        crash_like = [p for p in spec.phases if p.kind == "crash"
+                      or (p.kind == "gc" and p.sites)]
+        if crash_like:
+            want = sum(_crash_count(p) if p.kind == "crash"
+                       else len(p.sites) for p in crash_like)
             crashes = facts.get("crash_sites", [])
             injections = sum(
                 v for k, v in counters.items()
@@ -738,6 +826,23 @@ class ScenarioHarness:
             out.append(A("recovery_clean", bool(crashes) and not bad,
                          "all seams idempotent + violation-free"
                          if not bad else "dirty: " + ", ".join(bad)))
+        if any(p.kind == "gc" for p in spec.phases):
+            ok_runs = counters.get("bkw_gc_runs_total{outcome=ok}", 0)
+            out.append(A("gc_completed", ok_runs >= 1,
+                         f"ok_runs={ok_runs:g}"))
+            reclaimed = sum(
+                v for k, v in counters.items()
+                if k.startswith("bkw_gc_bytes_reclaimed_total"))
+            out.append(A("gc_reclaimed_bytes", reclaimed > 0,
+                         f"bytes_reclaimed={reclaimed:g}"))
+            # make-before-break's other end: the holders really deleted
+            # (every peer is in-process, so their serve-side counter
+            # lands in the same registry)
+            freed = sum(
+                v for k, v in counters.items()
+                if k.startswith("bkw_reclaim_bytes_freed_total"))
+            out.append(A("gc_holders_freed_bytes", freed > 0,
+                         f"reclaim_freed={freed:g}"))
         return out
 
 
@@ -803,6 +908,20 @@ def builtin_scenarios() -> Dict[str, ScenarioSpec]:
         "crash_full": ScenarioSpec(
             name="crash_full", seed=91, corpus_files=4,
             phases=(P("backup"), P("crash"), P("restore"))),
+        # gc: lifecycle race (tier-1); gc_full arms every GC commit seam
+        "gc": ScenarioSpec(
+            name="gc", seed=101, corpus_files=4,
+            phases=(P("backup"), P("gc"), P("restore"))),
+        "gc_full": ScenarioSpec(
+            name="gc_full", seed=111, corpus_files=4,
+            phases=(P("backup"),
+                    P("gc", sites=(
+                        "gc.prune.pre", "gc.prune.post",
+                        "gc.sweep.pre", "gc.sweep.post",
+                        "gc.compact.seal.pre", "gc.compact.seal.post",
+                        "gc.swap.pre", "gc.swap.post",
+                        "gc.reclaim.pre", "gc.reclaim.post")),
+                    P("restore"))),
         "full": ScenarioSpec(
             name="full", seed=61, spares=2, corpus_files=10,
             corpus_file_bytes=48 * 1024, min_shards_rebuilt=1,

@@ -83,6 +83,15 @@ COUNTER_FAMILIES = (
     # telemetry gate's evidence
     "bkw_restore_bytes_pulled_total",
     "bkw_restore_hedges_total",
+    # snapshot lifecycle plane (PR 13): GC runs, what each swap retired,
+    # and both ends of the reclaim protocol — the gc_* gates' evidence
+    "bkw_gc_runs_total",
+    "bkw_gc_snapshots_pruned_total",
+    "bkw_gc_packfiles_dropped_total",
+    "bkw_gc_packfiles_compacted_total",
+    "bkw_gc_bytes_reclaimed_total",
+    "bkw_reclaim_requests_total",
+    "bkw_reclaim_bytes_freed_total",
 )
 
 #: Histogram families quantiled in the card.

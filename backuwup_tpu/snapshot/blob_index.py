@@ -41,6 +41,14 @@ INDEX_KEY_INFO = b"index"
 CHALLENGE_KEY_INFO = b"audit"
 _NAME_RE = re.compile(r"^\d{6}$")
 
+#: Deletion record inside index files (docs/lifecycle.md).  GC flushes a
+#: (hash, TOMBSTONE_PID) entry for every blob it dropped; with the
+#: later-files-win load order that kills the mapping on reload (and on a
+#: restored index), so a dead blob can never dedup a future backup
+#: against a packfile that no longer exists.  Real packfile ids are 12
+#: random bytes, so the all-zero id is free to act as the sentinel.
+TOMBSTONE_PID = b"\x00" * PACKFILE_ID_LEN
+
 # Crash-matrix seams: the window either side of each durable commit.
 _CP_CHALLENGE_PRE = faults.register_crash_site("challenge.save.pre")
 _CP_CHALLENGE_POST = faults.register_crash_site("challenge.save.post")
@@ -118,6 +126,27 @@ class ChallengeTable:
         durable.commit_replace(tmp, path)
         faults.crashpoint(_CP_CHALLENGE_POST)
         return path
+
+    def forget(self, packfile_ids: Iterable[bytes]) -> int:
+        """Delete the table files of dead packfiles — BOTH the whole-file
+        table (12-byte id) and every per-shard table (13-byte id = the
+        packfile id plus one index byte, so its hex name extends the
+        packfile's).  Callers of ``BlobIndex.forget_packfiles`` pair it
+        with this so audit state cannot resurrect a dead packfile;
+        returns files removed.  Unlike ``save``, deletion is idempotent:
+        re-running after a crash just finds nothing left to remove."""
+        removed = 0
+        if not self.table_dir.is_dir():
+            return removed
+        for pid in packfile_ids:
+            prefix = bytes(pid).hex()
+            for path in self.table_dir.glob(f"{prefix}*"):
+                try:
+                    path.unlink()
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
 
     def load(self, packfile_id: bytes) -> List[ChallengeEntry]:
         pid = bytes(packfile_id)
@@ -204,6 +233,27 @@ class BlobIndex:
                          if pid not in targets]
         return lost
 
+    def record_tombstones(self, blob_hashes: Iterable[bytes]) -> int:
+        """Queue deletion records for dropped blobs (GC's swap step).
+
+        Unlike :meth:`forget_packfiles` — which only edits memory, on the
+        promise that the blobs are immediately re-packed — a tombstone is
+        flushed into the index files themselves, so the deletion survives
+        reload and restore.  Returns tombstones queued."""
+        n = 0
+        for h in blob_hashes:
+            h = bytes(h)
+            self._map.pop(h, None)
+            self._queued.discard(h)
+            self._unsaved.append((h, TOMBSTONE_PID))
+            n += 1
+        return n
+
+    def blob_map(self) -> Dict[bytes, bytes]:
+        """Committed hash -> packfile-id snapshot — GC's mark phase joins
+        this against the retained-snapshot manifests."""
+        return dict(self._map)
+
     def packfile_ids(self) -> Set[bytes]:
         return set(self._map.values())
 
@@ -278,7 +328,10 @@ class BlobIndex:
             for _ in range(r.u64()):
                 h = r.fixed(BLOB_HASH_LEN)
                 pid = r.fixed(PACKFILE_ID_LEN)
-                self._map[h] = pid
+                if pid == TOMBSTONE_PID:
+                    self._map.pop(h, None)
+                else:
+                    self._map[h] = pid
             r.expect_end()
             self._next_file = max(self._next_file, counter + 1)
         return len(self._map)

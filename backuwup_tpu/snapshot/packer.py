@@ -63,13 +63,19 @@ class DirPacker:
                  batch_bytes: int = 256 * defaults.MiB,
                  should_pause: Optional[Callable] = None,
                  dedup_batch: Optional[Callable] = None,
-                 dedup_index=None):
+                 dedup_index=None,
+                 on_blob: Optional[Callable] = None):
         self.backend = backend
         self.writer = writer
         self.index = index
         self.progress = progress or (lambda **kw: None)
         self.batch_bytes = batch_bytes
         self.should_pause = should_pause or (lambda: None)
+        # manifest hook: called (hash, size) for EVERY blob the snapshot
+        # references — duplicates included — so the caller can record the
+        # snapshot's full reachable-blob manifest (GC's mark source,
+        # docs/lifecycle.md) without a second tree walk
+        self.on_blob = on_blob
         # device dedup front.  ``dedup_index`` (a MeshDedupIndex) is the
         # full handle: pack batches then classify through the backend's
         # fused manifest+classify seam (on the TPU backend the digests
@@ -96,6 +102,8 @@ class DirPacker:
         128-bit truncation collisions in the device table's key prefix,
         see device_dedup.py), and degrading beats failing the whole backup.
         """
+        if self.on_blob is not None:
+            self.on_blob(bytes(blob_hash), len(data))
         host_dup = self.index.is_duplicate(blob_hash)
         if dup_hint is not None and dup_hint != host_dup:
             self.stats.dedup_divergences += 1

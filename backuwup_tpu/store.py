@@ -82,11 +82,33 @@ CREATE TABLE IF NOT EXISTS peer_stats (
     placement_demoted INTEGER NOT NULL DEFAULT 0,
     placement_demoted_at REAL NOT NULL DEFAULT 0
 );
+CREATE TABLE IF NOT EXISTS snapshots (
+    hash BLOB PRIMARY KEY,
+    parent BLOB,
+    created_at REAL NOT NULL,
+    size INTEGER NOT NULL DEFAULT 0,
+    pruned_at REAL NOT NULL DEFAULT 0
+);
+CREATE TABLE IF NOT EXISTS snapshot_blobs (
+    snapshot_hash BLOB NOT NULL,
+    blob_hash BLOB NOT NULL,
+    size INTEGER NOT NULL,
+    PRIMARY KEY (snapshot_hash, blob_hash)
+);
+CREATE TABLE IF NOT EXISTS reclaim_backlog (
+    file_id BLOB NOT NULL,
+    peer BLOB NOT NULL,
+    kind INTEGER NOT NULL,
+    size INTEGER NOT NULL DEFAULT 0,
+    queued_at REAL NOT NULL,
+    PRIMARY KEY (file_id, peer)
+);
 """
 
 EVENT_BACKUP = "backup"
 EVENT_RESTORE_REQUEST = "restore_request"
 EVENT_REPAIR = "repair"
+EVENT_GC = "gc"
 
 
 def config_dir() -> Path:
@@ -134,6 +156,24 @@ class PeerStatsRow:
     #: run of successes.
     placement_demoted: bool = False
     placement_demoted_at: float = 0.0
+
+
+@dataclass(frozen=True)
+class SnapshotRow:
+    """One snapshot's lineage row (docs/lifecycle.md; no reference
+    equivalent).  ``pruned_at`` > 0 means retention marked it dead —
+    pruning never touches data, only this flag; reclaiming the bytes is
+    GC's job."""
+
+    hash: bytes
+    parent: Optional[bytes]
+    created_at: float
+    size: int = 0
+    pruned_at: float = 0.0
+
+    @property
+    def retained(self) -> bool:
+        return self.pruned_at == 0.0
 
 
 @dataclass(frozen=True)
@@ -301,6 +341,25 @@ class Store:
     def add_peer_received(self, pubkey: bytes, amount: int) -> None:
         self._bump_peer(pubkey, "bytes_received", amount)
 
+    def credit_peer_transmitted(self, pubkey: bytes, amount: int) -> None:
+        """Clamped decrement after a holder acks a RECLAIM: the freed
+        bytes count against ``bytes_transmitted`` again as free storage.
+        Clamped at zero — a double-delivered ack must not mint quota."""
+        self._credit_peer(pubkey, "bytes_transmitted", amount)
+
+    def credit_peer_received(self, pubkey: bytes, amount: int) -> None:
+        """Holder-side quota credit when serving a RECLAIM: the deleted
+        packfiles stop counting against the requester's received quota."""
+        self._credit_peer(pubkey, "bytes_received", amount)
+
+    def _credit_peer(self, pubkey: bytes, column: str, amount: int) -> None:
+        with self._lock:
+            self._db.execute(
+                f"UPDATE peers SET {column} = MAX(0, {column} - ?),"
+                " last_seen = ? WHERE pubkey = ?",
+                (int(amount), time.time(), bytes(pubkey)))
+            self._db.commit()
+
     def _bump_peer(self, pubkey: bytes, column: str, amount: int,
                    now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
@@ -410,6 +469,15 @@ class Store:
                 " WHERE packfile_id = ?", (bytes(packfile_id),)).fetchall()
         return [(bytes(r[0]), int(r[1])) for r in rows]
 
+    def placements_for_packfile(self, packfile_id: bytes) -> list:
+        """[(peer, size, shard_index)] — GC's retire/reclaim walk needs
+        the per-row byte sizes alongside the stripe geometry."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT peer, size, shard_index FROM placements"
+                " WHERE packfile_id = ?", (bytes(packfile_id),)).fetchall()
+        return [(bytes(r[0]), int(r[1]), int(r[2])) for r in rows]
+
     def retire_placement(self, packfile_id: bytes, peer: bytes) -> int:
         """Drop one (packfile, peer) placement row — sourceless shard
         repair retires exactly the lost shard rows it re-homed."""
@@ -452,6 +520,208 @@ class Store:
         with self._lock:
             cur = self._db.execute(
                 "DELETE FROM placements WHERE peer = ?", (bytes(peer),))
+            self._db.commit()
+        return cur.rowcount
+
+    # --- snapshot lineage + retention (docs/lifecycle.md) -------------------
+
+    def record_snapshot(self, snapshot_hash: bytes, parent: Optional[bytes],
+                        size: int, blobs, now: Optional[float] = None) -> None:
+        """One transaction commits the lineage row AND its blob manifest
+        (``blobs`` iterates (blob_hash, size) for every blob the snapshot
+        references, duplicates included) — GC's mark phase is a local
+        join against these manifests, so a snapshot must never exist
+        without one (that is the legacy-store guard's trigger)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO snapshots (hash, parent, created_at, size)"
+                " VALUES (?, ?, ?, ?) ON CONFLICT(hash) DO UPDATE SET"
+                " pruned_at = 0",
+                (bytes(snapshot_hash),
+                 None if parent is None else bytes(parent),
+                 now, int(size)))
+            self._db.executemany(
+                "INSERT INTO snapshot_blobs (snapshot_hash, blob_hash, size)"
+                " VALUES (?, ?, ?)"
+                " ON CONFLICT(snapshot_hash, blob_hash) DO NOTHING",
+                [(bytes(snapshot_hash), bytes(h), int(s))
+                 for h, s in blobs])
+            self._db.commit()
+
+    def get_snapshot(self, snapshot_hash: bytes) -> Optional["SnapshotRow"]:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT hash, parent, created_at, size, pruned_at"
+                " FROM snapshots WHERE hash = ?",
+                (bytes(snapshot_hash),)).fetchone()
+        if row is None:
+            return None
+        return SnapshotRow(bytes(row[0]),
+                           None if row[1] is None else bytes(row[1]),
+                           float(row[2]), int(row[3]), float(row[4]))
+
+    def list_snapshots(self) -> list:
+        """Every lineage row (pruned included), oldest first."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT hash, parent, created_at, size, pruned_at"
+                " FROM snapshots ORDER BY created_at, hash").fetchall()
+        return [SnapshotRow(bytes(r[0]),
+                            None if r[1] is None else bytes(r[1]),
+                            float(r[2]), int(r[3]), float(r[4]))
+                for r in rows]
+
+    def retained_snapshots(self) -> list:
+        return [s for s in self.list_snapshots() if s.retained]
+
+    def latest_snapshot(self) -> Optional["SnapshotRow"]:
+        """Most recent RETAINED snapshot — the parent link for the next
+        backup and the one snapshot retention may never prune."""
+        retained = self.retained_snapshots()
+        return retained[-1] if retained else None
+
+    def prune_snapshots(self, hashes, now: Optional[float] = None) -> int:
+        """Mark snapshots dead.  Never touches data — the blobs stay
+        until GC proves nothing retained references them."""
+        now = time.time() if now is None else now
+        with self._lock:
+            cur = self._db.executemany(
+                "UPDATE snapshots SET pruned_at = ?"
+                " WHERE hash = ? AND pruned_at = 0",
+                [(now, bytes(h)) for h in hashes])
+            self._db.commit()
+        return cur.rowcount
+
+    def get_retention_policy(self) -> Optional[str]:
+        v = self._get("retention_policy")
+        return None if v is None else v.decode()
+
+    def set_retention_policy(self, policy: Optional[str]) -> None:
+        self._set("retention_policy",
+                  None if policy is None else policy.encode())
+
+    def apply_retention(self, policy: Optional[str] = None,
+                        now: Optional[float] = None) -> list:
+        """Compute and mark the prune set under the named policy
+        (comma-separated ``keep-last:N`` / ``keep-daily:N`` rules; a
+        snapshot kept by ANY rule is retained).  The newest retained
+        snapshot is always kept regardless of policy — retention must
+        never walk the store back past the latest restorable state.
+        Returns the pruned hashes."""
+        policy = self.get_retention_policy() if policy is None else policy
+        if not policy or policy.strip() == "keep-all":
+            return []
+        snaps = self.retained_snapshots()
+        snaps.reverse()  # newest first
+        if not snaps:
+            return []
+        keep = {snaps[0].hash}
+        for rule in policy.split(","):
+            rule = rule.strip()
+            if not rule:
+                continue
+            name, _, arg = rule.partition(":")
+            try:
+                n = int(arg)
+            except ValueError:
+                raise ValueError(f"bad retention rule {rule!r}")
+            if name == "keep-last":
+                keep.update(s.hash for s in snaps[:n])
+            elif name == "keep-daily":
+                # newest snapshot per UTC day, for the N newest days
+                days: dict = {}
+                for s in snaps:
+                    days.setdefault(int(s.created_at // 86400), s.hash)
+                for day in sorted(days, reverse=True)[:n]:
+                    keep.add(days[day])
+            else:
+                raise ValueError(f"unknown retention rule {rule!r}")
+        prune = [s.hash for s in snaps if s.hash not in keep]
+        if prune:
+            self.prune_snapshots(prune, now=now)
+        return prune
+
+    def live_blobs(self) -> dict:
+        """blob_hash -> size over every blob some RETAINED snapshot's
+        manifest references — GC's mark phase in one query."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT sb.blob_hash, MAX(sb.size) FROM snapshot_blobs sb"
+                " JOIN snapshots s ON s.hash = sb.snapshot_hash"
+                " WHERE s.pruned_at = 0 GROUP BY sb.blob_hash").fetchall()
+        return {bytes(r[0]): int(r[1]) for r in rows}
+
+    def manifest_blobs(self) -> dict:
+        """blob_hash -> size over EVERY manifest row, pruned snapshots
+        included — GC's occupancy denominator (a packfile's total known
+        payload, live or dead)."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT blob_hash, MAX(size) FROM snapshot_blobs"
+                " GROUP BY blob_hash").fetchall()
+        return {bytes(r[0]): int(r[1]) for r in rows}
+
+    def snapshots_without_manifest(self) -> list:
+        """Retained snapshots with NO manifest rows — pre-lifecycle
+        backups GC cannot reason about, so it must refuse to collect."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT s.hash FROM snapshots s WHERE s.pruned_at = 0"
+                " AND NOT EXISTS (SELECT 1 FROM snapshot_blobs sb"
+                " WHERE sb.snapshot_hash = s.hash)").fetchall()
+        return [bytes(r[0]) for r in rows]
+
+    def drop_pruned_manifests(self) -> int:
+        """Delete manifest rows belonging to pruned snapshots (the
+        lineage tombstone row itself stays); returns rows dropped."""
+        with self._lock:
+            cur = self._db.execute(
+                "DELETE FROM snapshot_blobs WHERE snapshot_hash IN"
+                " (SELECT hash FROM snapshots WHERE pruned_at > 0)")
+            self._db.commit()
+        return cur.rowcount
+
+    # --- GC run state (crash roll-forward; docs/lifecycle.md) ---------------
+
+    def get_gc_state(self) -> Optional[dict]:
+        v = self._get("gc_state")
+        return None if v is None else json.loads(v)
+
+    def set_gc_state(self, state: Optional[dict]) -> None:
+        self._set("gc_state",
+                  None if state is None
+                  else json.dumps(state, sort_keys=True).encode())
+
+    # --- reclaim backlog (make-before-break's best-effort tail) -------------
+
+    def queue_reclaim(self, file_id: bytes, peer: bytes, kind: int,
+                      size: int, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO reclaim_backlog"
+                " (file_id, peer, kind, size, queued_at)"
+                " VALUES (?, ?, ?, ?, ?)"
+                " ON CONFLICT(file_id, peer) DO NOTHING",
+                (bytes(file_id), bytes(peer), int(kind), int(size), now))
+            self._db.commit()
+
+    def reclaim_backlog(self) -> list:
+        """[(file_id, peer, kind, size)], oldest queued first."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT file_id, peer, kind, size FROM reclaim_backlog"
+                " ORDER BY queued_at, file_id").fetchall()
+        return [(bytes(r[0]), bytes(r[1]), int(r[2]), int(r[3]))
+                for r in rows]
+
+    def clear_reclaim(self, file_id: bytes, peer: bytes) -> int:
+        with self._lock:
+            cur = self._db.execute(
+                "DELETE FROM reclaim_backlog"
+                " WHERE file_id = ? AND peer = ?",
+                (bytes(file_id), bytes(peer)))
             self._db.commit()
         return cur.rowcount
 

@@ -466,6 +466,9 @@ class RequestType(IntEnum):
     RESTORE_ALL = 1
     AUDIT = 2
     RESTORE_FETCH = 3
+    # GC's make-before-break tail: the owner asks a holder to delete
+    # superseded packfiles/shards it placed there (docs/lifecycle.md)
+    RECLAIM = 4
 
 
 class FileInfoKind(IntEnum):
@@ -511,6 +514,13 @@ class P2PBodyKind(IntEnum):
     # Additive like the resume trio: only sent on RESTORE_FETCH sessions,
     # which old peers never accept, so RESTORE_ALL interop is untouched.
     FETCH_REQUEST = 8  # puller names the stored items it wants
+    # GC reclaim (docs/lifecycle.md).  Additive like FETCH_REQUEST: only
+    # sent on RECLAIM sessions, which old peers never accept.  The
+    # request reuses the (FileInfoKind, file_id) pair shape of wants;
+    # the ack echoes the request's sequence number (the CHALLENGE/PROOF
+    # correlation idiom) and reports bytes actually freed in ``offset``.
+    RECLAIM_REQUEST = 9
+    RECLAIM_ACK = 10
 
 
 class ProofStatus(IntEnum):
@@ -590,11 +600,11 @@ class P2PBody:
     acked_sequence: int = 0  # ACK
     challenges: tuple = ()  # CHALLENGE: StorageChallenge...
     proofs: tuple = ()  # PROOF: StorageProof...
-    offset: int = 0  # FILE_PART: byte offset / RESUME_OFFER: verified bytes held
+    offset: int = 0  # FILE_PART: byte offset / RESUME_OFFER: verified bytes held / RECLAIM_ACK: bytes freed
     total_size: int = 0  # FILE_PART: whole-file length
     file_digest: bytes = b""  # FILE_PART / RESUME_OFFER: whole-file blake3
     prefix_digest: bytes = b""  # RESUME_OFFER: blake3 of the held prefix
-    wants: tuple = ()  # FETCH_REQUEST: (FileInfoKind, file_id) pairs
+    wants: tuple = ()  # FETCH_REQUEST / RECLAIM_REQUEST: (FileInfoKind, file_id) pairs
 
     def encode_bytes(self) -> bytes:
         w = Writer()
@@ -632,11 +642,15 @@ class P2PBody:
             # both digests are empty blobs when nothing is held
             w.blob(self.file_digest)
             w.blob(self.prefix_digest)
-        elif self.kind == P2PBodyKind.FETCH_REQUEST:
+        elif self.kind in (P2PBodyKind.FETCH_REQUEST,
+                           P2PBodyKind.RECLAIM_REQUEST):
             w.u64(len(self.wants))
             for fi, fid in self.wants:
                 w.u32(int(fi))
                 w.blob(fid)
+        elif self.kind == P2PBodyKind.RECLAIM_ACK:
+            w.u64(self.acked_sequence)
+            w.u64(self.offset)  # bytes freed
         return w.take()
 
     @classmethod
@@ -674,9 +688,13 @@ class P2PBody:
             kw["offset"] = r.u64()
             kw["file_digest"] = r.blob()
             kw["prefix_digest"] = r.blob()
-        elif kind == P2PBodyKind.FETCH_REQUEST:
+        elif kind in (P2PBodyKind.FETCH_REQUEST,
+                      P2PBodyKind.RECLAIM_REQUEST):
             kw["wants"] = tuple(
                 (FileInfoKind(r.u32()), r.blob()) for _ in range(r.u64()))
+        elif kind == P2PBodyKind.RECLAIM_ACK:
+            kw["acked_sequence"] = r.u64()
+            kw["offset"] = r.u64()
         r.expect_end()
         return cls(kind=kind, header=header, **kw)
 

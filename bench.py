@@ -254,6 +254,13 @@ def main() -> None:
     multichip = configs.get("14_multichip", {})
     if "speedup" in multichip:
         record["multichip_speedup"] = multichip["speedup"]
+    # config #15 is the snapshot lifecycle plane: surface how much of the
+    # shipped data GC reclaimed (and the zero-violation verdict) at top
+    # level so BENCH_r*.json diffs track the collector directly
+    gc = configs.get("15_gc", {})
+    if "gc_reclaim_ratio" in gc:
+        record["gc_reclaim_ratio"] = gc["gc_reclaim_ratio"]
+        record["gc_passed"] = gc.get("passed")
     print(json.dumps({
         **record,
         "note": "corpus synthesized on-device (host<->device relay tunnel "

@@ -1197,6 +1197,72 @@ def config13_restore(log: Callable) -> Dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def config15_gc(log: Callable) -> Dict:
+    """Snapshot lifecycle plane: retention + GC under a crash — #15.
+
+    Runs a dedicated GC scenario (scenario/harness.py): populate via
+    backup, then a ``gc`` phase with ONE armed commit seam
+    (``gc.swap.post`` — the make-before-break commit point): retention
+    prunes to keep-last:1, the GC run crashes at the seam, the client
+    restarts, the startup recovery sweep rolls the interrupted swap
+    forward, and a clean re-run finishes reclaiming; a final ``restore``
+    phase proves the post-GC world restores byte-identically.
+
+    Hard gates (the scorecard's, restated in the record): bytes actually
+    reclaimed on the holders (> 0 at both ends of the RECLAIM protocol),
+    zero durability-violation seconds at every sample while packfiles
+    were dropped and compacted, and the byte-identical final restore.
+    ``gc_reclaim_ratio`` is reclaimed-bytes / bytes-on-wire for the whole
+    run — how much of what the run shipped GC later proved dead.
+    """
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    from backuwup_tpu.scenario import (Phase, ScenarioSpec, builtin_scenarios,
+                                       run_scenario)
+
+    site = os.environ.get("BENCH_C15_SITE", "gc.swap.post")
+    spec = ScenarioSpec(
+        name="gc_bench", seed=151,
+        corpus_files=builtin_scenarios()["gc"].corpus_files,
+        phases=(Phase("backup"),
+                Phase("gc", sites=(site,)),
+                Phase("restore")))
+    with tempfile.TemporaryDirectory(prefix="bkw_bench_gc_") as td:
+        card = asyncio.run(run_scenario(spec, Path(td)))
+    counters = card.counters
+    reclaimed = sum(v for k, v in counters.items()
+                    if k.startswith("bkw_gc_bytes_reclaimed_total"))
+    freed = sum(v for k, v in counters.items()
+                if k.startswith("bkw_reclaim_bytes_freed_total"))
+    dropped = sum(v for k, v in counters.items()
+                  if k.startswith("bkw_gc_packfiles_dropped_total"))
+    compacted = sum(v for k, v in counters.items()
+                    if k.startswith("bkw_gc_packfiles_compacted_total"))
+    wire = sum(v for k, v in counters.items()
+               if k.startswith("bkw_transfer_bytes_total"))
+    ratio = reclaimed / max(wire, 1.0)
+    violation_s = card.invariants["violation_seconds"]
+    passed = card.passed and reclaimed > 0 and freed > 0 \
+        and violation_s == 0
+    log(f"config#15 gc '{card.scenario}' (seed {card.seed}, crash {site}):"
+        f" {'PASS' if passed else 'FAIL'} in {card.elapsed_s:.1f}s, "
+        f"reclaimed={reclaimed / 1024:.0f}KiB freed={freed / 1024:.0f}KiB "
+        f"dropped={dropped:g} compacted={compacted:g} "
+        f"ratio={ratio:.3f} violation_s={violation_s}")
+    return {"passed": passed,
+            "gc_reclaim_ratio": round(ratio, 4),
+            "bytes_reclaimed": int(reclaimed),
+            "holder_bytes_freed": int(freed),
+            "packfiles_dropped": int(dropped),
+            "packfiles_compacted": int(compacted),
+            "violation_seconds": violation_s,
+            "crash_site": site,
+            "wall_s": round(card.elapsed_s, 2),
+            "scorecard": card.to_dict()}
+
+
 def config14_multichip(log: Callable, n_devices: int = 0) -> Dict:
     """Matched-work single-device vs mesh manifest plane — config #14.
 
@@ -1356,7 +1422,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("11_crash", lambda: config11_crash(log)),
             ("12_swarm", lambda: config12_swarm(log)),
             ("13_restore", lambda: config13_restore(log)),
-            ("14_multichip", lambda: config14_multichip(log))):
+            ("14_multichip", lambda: config14_multichip(log)),
+            ("15_gc", lambda: config15_gc(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

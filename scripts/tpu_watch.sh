@@ -128,6 +128,19 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "multichip bench recapture FAILED (see $mcp) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated gc recapture: config #15 alone (host-only snapshot
+        # lifecycle scenario: retention prune + mark-and-sweep GC with
+        # one armed commit-seam crash + resume, then a byte-identical
+        # restore) — the gc_reclaim_ratio number and the zero-violation
+        # verdict survive even when the device suite timed out partway
+        gcb="$BENCH_OUT_DIR/BENCH_gc_${stamp}.json"
+        if timeout "${BENCH_GC_TIMEOUT_S:-600}" \
+                env BENCH_ONLY_CONFIG=15_gc BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$gcb" 2>>/tmp/tpu_watch.log; then
+            echo "gc bench recaptured to $gcb at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "gc bench recapture FAILED (see $gcb) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

@@ -343,6 +343,13 @@ def test_audit_e2e_detects_corruption_and_demotes(tmp_path, loop,
                               if ev.kind == "audit" else None)
         await a.start()
         await b.start()
+        # this test drives audit verdicts against data that stays put on
+        # b; each failing leg demotes b, and the background repair that
+        # demotion fires would orphan b's packfiles and retire their
+        # challenge tables (dead data must not stay auditable), starving
+        # the later legs — drive audits only, per the engine's test
+        # contract
+        a.engine.auto_repair = False
         await asyncio.wait_for(asyncio.gather(a.backup(), b.backup()), 120)
         assert a.store.peers_with_placements(), "no placements recorded"
 

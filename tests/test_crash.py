@@ -62,6 +62,12 @@ EXPECTED_SITES = {
     "placement.insert.pre", "placement.insert.post",
     "repair.rehome.pre", "repair.rehome.post",
     "stripe.finish.pre", "stripe.finish.post",
+    # the GC state machine's seams (docs/lifecycle.md)
+    "gc.prune.pre", "gc.prune.post",
+    "gc.sweep.pre", "gc.sweep.post",
+    "gc.compact.seal.pre", "gc.compact.seal.post",
+    "gc.swap.pre", "gc.swap.post",
+    "gc.reclaim.pre", "gc.reclaim.post",
 }
 
 
