@@ -21,6 +21,10 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
 RUN pip install --no-cache-dir aiohttp cryptography numpy websockets
 
 COPY backuwup_tpu /app/backuwup_tpu
+# the check role (BKW_ROLE=check) lints the shipped tree in place:
+# the catalog + baseline ride along so the gate sees what CI sees
+COPY docs/observability.md /app/docs/observability.md
+COPY .bkwlint-baseline.json /app/.bkwlint-baseline.json
 
 ENV BKW_ROLE=${ROLE}
 ENV SERVER_BIND=0.0.0.0:9999

@@ -1,0 +1,33 @@
+"""bkwlint: AST-based invariant linter for backuwup_tpu.
+
+Five rules over a shared package loader + call-graph:
+
+* **BKW001** — blocking I/O reachable from ``async def`` without the
+  executor seam (event-loop purity).
+* **BKW002** — ``await`` while holding a ``threading.Lock``/``RLock``.
+* **BKW003** — crash-seam coverage: durable commits need an adjacent
+  ``faults.crashpoint``, and the crash-site registry must be exact.
+* **BKW004** — ``bkw_*`` metric families vs ``docs/observability.md``,
+  both directions, with consistent label sets.
+* **BKW005** — wire-enum members vs serve/dispatch arms in net/p2p.py.
+
+Entry points: ``scripts/bkwlint.py``, ``python -m
+backuwup_tpu.analysis``, or :func:`run_lint` directly.  See
+``docs/analysis.md``.
+"""
+
+from .baseline import (BaselineError, apply_baseline, load_baseline,
+                       write_baseline)
+from .callgraph import CallGraph, build_graph
+from .findings import (RULE_IDS, SEV_ERROR, SEV_WARNING, Finding,
+                       LintReport)
+from .loader import Package, load_package
+from .rules_crash import static_crash_sites
+from .runner import LintConfig, collect_findings, load_graph, run_lint
+
+__all__ = [
+    "BaselineError", "CallGraph", "Finding", "LintConfig", "LintReport",
+    "Package", "RULE_IDS", "SEV_ERROR", "SEV_WARNING", "apply_baseline",
+    "build_graph", "collect_findings", "load_baseline", "load_graph",
+    "load_package", "run_lint", "static_crash_sites", "write_baseline",
+]

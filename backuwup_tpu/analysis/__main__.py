@@ -1,0 +1,8 @@
+"""``python -m backuwup_tpu.analysis`` — the container check role's
+entry point (no scripts/ tree needed inside the image)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

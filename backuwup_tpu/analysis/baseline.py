@@ -1,0 +1,83 @@
+"""Baseline/allowlist for bkwlint: deliberate exceptions, justified.
+
+``.bkwlint-baseline.json`` holds entries ``{"key", "justification"}``
+matched against :attr:`Finding.key` — the line-independent identity, so
+a baseline survives unrelated edits.  Two hard rules:
+
+* every entry MUST carry a non-empty justification (an unexplained
+  exception is just a suppressed bug), and
+* an entry matching **no** current finding is *stale* and fails the
+  gate — fixed code must shed its exception, or the baseline rots into
+  an allowlist nobody can audit.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from .findings import Finding, LintReport
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file."""
+
+
+def load_baseline(path: Optional[Path]) -> Dict[str, str]:
+    """key -> justification (empty when ``path`` is None/missing)."""
+    if path is None:
+        return {}
+    path = Path(path)
+    if not path.exists():
+        return {}
+    try:
+        doc = json.loads(path.read_text())
+    except ValueError as e:
+        raise BaselineError(f"{path}: not valid JSON: {e}") from e
+    if not isinstance(doc, dict) or doc.get("version") != 1 \
+            or not isinstance(doc.get("entries"), list):
+        raise BaselineError(
+            f"{path}: expected {{'version': 1, 'entries': [...]}}")
+    out: Dict[str, str] = {}
+    for i, entry in enumerate(doc["entries"]):
+        if not isinstance(entry, dict) or not entry.get("key") \
+                or not str(entry.get("justification", "")).strip():
+            raise BaselineError(
+                f"{path}: entry {i} needs a key and a non-empty"
+                f" justification")
+        if entry["key"] in out:
+            raise BaselineError(
+                f"{path}: duplicate key {entry['key']!r}")
+        out[entry["key"]] = str(entry["justification"])
+    return out
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[str, str]) -> LintReport:
+    """Split findings into active/suppressed; flag unmatched entries."""
+    report = LintReport()
+    matched = set()
+    for f in findings:
+        if f.key in baseline:
+            matched.add(f.key)
+            report.baselined.append(f)
+        else:
+            report.findings.append(f)
+    for key, why in baseline.items():
+        if key not in matched:
+            report.stale_baseline.append(
+                {"key": key, "justification": why})
+    return report
+
+
+def write_baseline(path: Path, findings: List[Finding],
+                   justification: str) -> None:
+    """Regenerate a baseline from current findings (one shared
+    placeholder justification — edit per-entry before committing)."""
+    entries = [{"key": f.key, "justification": justification,
+                "message": f.message}
+               for f in sorted(findings, key=lambda f: f.key)]
+    Path(path).write_text(json.dumps(
+        {"version": 1, "entries": entries}, indent=2, sort_keys=False)
+        + "\n")

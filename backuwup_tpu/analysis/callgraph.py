@@ -1,0 +1,376 @@
+"""Package-wide call-graph with name resolution good enough for the
+intra-package idioms this codebase actually uses.
+
+The graph is deliberately *not* a type checker.  It resolves exactly the
+call shapes the rules need to follow — and treats everything else as an
+opaque leaf:
+
+* module-level calls: ``helper()``, ``durable.write_replace()``,
+  ``from .store import Store; Store(...)`` (a class call resolves to its
+  ``__init__``);
+* ``self.method()`` through the enclosing class, its in-package bases,
+  *and* its in-package subclasses (the mixin idiom:
+  ``_ResumableSinkMixin.sink_part`` touching ``self.partials`` that only
+  ``ReceivedFilesWriter.__init__`` assigns);
+* one level of instance-attribute typing: ``self.x = C(...)``,
+  ``self.x = C.load(...)``, and ``def __init__(self, x: C)`` +
+  ``self.x = x`` all record ``x: C`` so ``self.x.m()`` resolves to
+  ``C.m``;
+* locally defined nested functions called by name.
+
+Nested ``def``/``lambda`` bodies are **not** part of the enclosing
+function's behavior — defining a closure is not calling it — so a
+``pack_thread`` handed to ``run_in_executor`` never pollutes its async
+parent.  Each nested function is its own node.
+
+Every function node carries its :class:`CallSite` list (resolved target
++ dotted repr), which is all the rules need: BKW001 walks edges, BKW003
+walks them backwards, and everything pattern-matches on the repr.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .loader import EXTERNAL, ModuleInfo, Package, dotted_repr
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclass
+class CallSite:
+    """One call expression inside a function body."""
+
+    node: ast.Call
+    repr: str  # dotted source form, e.g. "self.index.flush"
+    norm: str  # external-alias-normalized form, e.g. "time.sleep"
+    target: Optional[str]  # resolved FuncInfo.fid, if any
+
+
+@dataclass
+class FuncInfo:
+    fid: str  # "rel::qualname"
+    module: ModuleInfo
+    qualname: str
+    node: object  # ast.FunctionDef | ast.AsyncFunctionDef
+    is_async: bool
+    cls: Optional[str]  # owning ClassInfo.cid
+    parent: Optional[str]  # enclosing FuncInfo.fid for nested defs
+    calls: List[CallSite] = field(default_factory=list)
+    nested: Dict[str, str] = field(default_factory=dict)  # name -> fid
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+@dataclass
+class ClassInfo:
+    cid: str  # "rel::ClassName"
+    module: ModuleInfo
+    name: str
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)  # resolved cids
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> fid
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> cid
+
+
+class CallGraph:
+    def __init__(self, pkg: Package):
+        self.pkg = pkg
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self._toplevel: Dict[Tuple[str, str], str] = {}  # (mod, name)->fid
+        self._mod_classes: Dict[Tuple[str, str], str] = {}
+        self._derived: Dict[str, List[str]] = {}
+        self._callers: Dict[str, Set[str]] = {}
+        self._build()
+
+    # --- construction -------------------------------------------------------
+
+    def _build(self) -> None:
+        for mod in self.pkg.modules.values():
+            self._scan_module(mod)
+        for cls in self.classes.values():
+            self._resolve_bases(cls)
+        for cls in self.classes.values():
+            self._infer_attr_types(cls)
+        for fn in self.functions.values():
+            self._resolve_calls(fn)
+        for fn in self.functions.values():
+            for cs in fn.calls:
+                if cs.target:
+                    self._callers.setdefault(cs.target, set()).add(fn.fid)
+
+    def _scan_module(self, mod: ModuleInfo) -> None:
+        for node in mod.tree.body:
+            if isinstance(node, _FUNC_NODES):
+                self._add_function(mod, node, node.name, None, None)
+            elif isinstance(node, ast.ClassDef):
+                cid = f"{mod.rel}::{node.name}"
+                cls = ClassInfo(cid=cid, module=mod, name=node.name,
+                                node=node)
+                self.classes[cid] = cls
+                self._mod_classes[(mod.name, node.name)] = cid
+                for item in node.body:
+                    if isinstance(item, _FUNC_NODES):
+                        fid = self._add_function(
+                            mod, item, f"{node.name}.{item.name}", cid,
+                            None)
+                        cls.methods[item.name] = fid
+
+    def _add_function(self, mod: ModuleInfo, node, qualname: str,
+                      cls: Optional[str], parent: Optional[str]) -> str:
+        fid = f"{mod.rel}::{qualname}"
+        info = FuncInfo(fid=fid, module=mod, qualname=qualname, node=node,
+                        is_async=isinstance(node, ast.AsyncFunctionDef),
+                        cls=cls, parent=parent)
+        self.functions[fid] = info
+        if parent is None and cls is None:
+            self._toplevel[(mod.name, node.name)] = fid
+        for child in self._body_walk(node):
+            if isinstance(child, _FUNC_NODES):
+                cfid = self._add_function(
+                    mod, child, f"{qualname}.<locals>.{child.name}", cls,
+                    fid)
+                info.nested[child.name] = cfid
+        return fid
+
+    @staticmethod
+    def _body_walk(func_node) -> Iterable[ast.AST]:
+        """Every node lexically inside ``func_node`` but NOT inside a
+        nested def/lambda (those are separate nodes)."""
+        stack = list(ast.iter_child_nodes(func_node))
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, _FUNC_NODES + (ast.Lambda,)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def body_nodes(self, fn: FuncInfo) -> Iterable[ast.AST]:
+        return self._body_walk(fn.node)
+
+    # --- class hierarchy ----------------------------------------------------
+
+    def _resolve_class_name(self, mod: ModuleInfo,
+                            node: ast.AST) -> Optional[str]:
+        """An expression naming a class -> cid (in-package only)."""
+        rep = dotted_repr(node)
+        if rep is None:
+            return None
+        parts = rep.split(".")
+        if len(parts) == 1:
+            cid = self._mod_classes.get((mod.name, parts[0]))
+            if cid:
+                return cid
+            fi = mod.from_imports.get(parts[0])
+            if fi:
+                return self._mod_classes.get(fi)
+            sub = mod.imports.get(parts[0])
+            if sub and not sub.startswith(EXTERNAL):
+                return None  # a module, not a class
+            return None
+        head, rest = parts[0], parts[1:]
+        target_mod = mod.imports.get(head)
+        if target_mod and not target_mod.startswith(EXTERNAL) \
+                and len(rest) == 1:
+            return self._mod_classes.get((target_mod, rest[0]))
+        return None
+
+    def _resolve_bases(self, cls: ClassInfo) -> None:
+        for base in cls.node.bases:
+            cid = self._resolve_class_name(cls.module, base)
+            if cid:
+                cls.bases.append(cid)
+                self._derived.setdefault(cid, []).append(cls.cid)
+
+    def _class_family(self, cid: str) -> List[str]:
+        """cid + bases (transitive) + derived (transitive), cycles-safe."""
+        seen: List[str] = []
+        stack = [cid]
+        while stack:
+            c = stack.pop()
+            if c in seen or c not in self.classes:
+                continue
+            seen.append(c)
+            stack.extend(self.classes[c].bases)
+            stack.extend(self._derived.get(c, []))
+        return seen
+
+    def lookup_method(self, cid: str, name: str) -> Optional[str]:
+        for c in self._class_family(cid):
+            fid = self.classes[c].methods.get(name)
+            if fid:
+                return fid
+        return None
+
+    def _lookup_attr_type(self, cid: str, attr: str) -> Optional[str]:
+        for c in self._class_family(cid):
+            t = self.classes[c].attr_types.get(attr)
+            if t:
+                return t
+        return None
+
+    def _infer_attr_types(self, cls: ClassInfo) -> None:
+        mod = cls.module
+        for item in cls.node.body:
+            if not isinstance(item, _FUNC_NODES):
+                continue
+            ann: Dict[str, Optional[str]] = {}
+            for arg in list(item.args.args) + list(item.args.kwonlyargs):
+                if arg.annotation is not None:
+                    ann[arg.arg] = self._resolve_class_name(
+                        mod, arg.annotation)
+            for n in self._body_walk(item):
+                if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+                    continue
+                tgt = n.targets[0]
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                tcid = None
+                v = n.value
+                if isinstance(v, ast.Call):
+                    tcid = self._resolve_class_name(mod, v.func)
+                    if tcid is None and isinstance(v.func, ast.Attribute):
+                        # alternate constructor: C.load(...)
+                        tcid = self._resolve_class_name(mod, v.func.value)
+                elif isinstance(v, ast.Name):
+                    tcid = ann.get(v.id)
+                if tcid:
+                    cls.attr_types.setdefault(tgt.attr, tcid)
+
+    # --- call resolution ----------------------------------------------------
+
+    def _normalize(self, mod: ModuleInfo, rep: str) -> str:
+        """Map import aliases to real module names for pattern matching
+        (``import subprocess as sp`` -> ``subprocess.*``)."""
+        parts = rep.split(".")
+        target = mod.imports.get(parts[0])
+        if target and target.startswith(EXTERNAL + ":"):
+            real = target[len(EXTERNAL) + 1:]
+            return ".".join([real] + parts[1:])
+        return rep
+
+    def _resolve_target(self, fn: FuncInfo,
+                        call: ast.Call) -> Optional[str]:
+        mod = fn.module
+        f = call.func
+        rep = dotted_repr(f)
+        if rep is None:
+            return None
+        parts = rep.split(".")
+        # plain name: nested fn, module function, from-import, class
+        if len(parts) == 1:
+            name = parts[0]
+            cur: Optional[FuncInfo] = fn
+            while cur is not None:
+                if name in cur.nested:
+                    return cur.nested[name]
+                cur = self.functions.get(cur.parent) if cur.parent \
+                    else None
+            fid = self._toplevel.get((mod.name, name))
+            if fid:
+                return fid
+            cid = self._mod_classes.get((mod.name, name))
+            if cid:
+                return self.lookup_method(cid, "__init__")
+            fi = mod.from_imports.get(name)
+            if fi:
+                fid = self._toplevel.get(fi)
+                if fid:
+                    return fid
+                cid = self._mod_classes.get(fi)
+                if cid:
+                    return self.lookup_method(cid, "__init__")
+            return None
+        # self.m() / self.attr.m() / cls.m()
+        if parts[0] in ("self", "cls") and fn.cls:
+            if len(parts) == 2:
+                return self.lookup_method(fn.cls, parts[1])
+            if len(parts) == 3:
+                tcid = self._lookup_attr_type(fn.cls, parts[1])
+                if tcid:
+                    return self.lookup_method(tcid, parts[2])
+            return None
+        # module.func() / module.Class() / Class.method()
+        target_mod = mod.imports.get(parts[0])
+        if target_mod is not None and not target_mod.startswith(EXTERNAL):
+            if len(parts) == 2:
+                fid = self._toplevel.get((target_mod, parts[1]))
+                if fid:
+                    return fid
+                cid = self._mod_classes.get((target_mod, parts[1]))
+                if cid:
+                    return self.lookup_method(cid, "__init__")
+            elif len(parts) == 3:
+                cid = self._mod_classes.get((target_mod, parts[1]))
+                if cid:
+                    return self.lookup_method(cid, parts[2])
+            return None
+        cid = self._resolve_class_name(mod, f.value) \
+            if isinstance(f, ast.Attribute) else None
+        if cid and len(parts) >= 2:
+            return self.lookup_method(cid, parts[-1])
+        return None
+
+    def _resolve_calls(self, fn: FuncInfo) -> None:
+        for n in self._body_walk(fn.node):
+            if not isinstance(n, ast.Call):
+                continue
+            rep = dotted_repr(n.func)
+            if rep is None:
+                continue
+            fn.calls.append(CallSite(
+                node=n, repr=rep, norm=self._normalize(fn.module, rep),
+                target=self._resolve_target(fn, n)))
+
+    # --- queries ------------------------------------------------------------
+
+    def callers_of(self, fid: str) -> Set[str]:
+        return self._callers.get(fid, set())
+
+    def async_functions(self) -> List[FuncInfo]:
+        return [f for f in self.functions.values() if f.is_async]
+
+    def reachable_from(self, fid: str,
+                       skip_call=None) -> Dict[str, Tuple[str, CallSite]]:
+        """BFS over resolved edges: reached fid -> (via fid, call site).
+
+        ``skip_call(site) -> bool`` prunes edges (the executor seam).
+        The parent links let rules print a human call chain.
+        """
+        parents: Dict[str, Tuple[str, CallSite]] = {}
+        queue = [fid]
+        seen = {fid}
+        while queue:
+            cur = queue.pop(0)
+            info = self.functions.get(cur)
+            if info is None:
+                continue
+            for cs in info.calls:
+                if skip_call is not None and skip_call(cs):
+                    continue
+                if cs.target and cs.target not in seen:
+                    seen.add(cs.target)
+                    parents[cs.target] = (cur, cs)
+                    queue.append(cs.target)
+        return parents
+
+    def chain(self, root: str, fid: str,
+              parents: Dict[str, Tuple[str, CallSite]]) -> List[str]:
+        """Qualname path root -> ... -> fid from a reachable_from map."""
+        names = [self.functions[fid].qualname]
+        cur = fid
+        while cur != root and cur in parents:
+            cur = parents[cur][0]
+            names.append(self.functions[cur].qualname)
+        return list(reversed(names))
+
+
+def build_graph(pkg: Package) -> CallGraph:
+    return CallGraph(pkg)

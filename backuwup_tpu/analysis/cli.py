@@ -1,0 +1,127 @@
+"""bkwlint command line.
+
+Exit-code contract (stable — scripted callers depend on it):
+
+* ``0`` — clean: no unbaselined findings, no stale baseline entries
+* ``1`` — unbaselined findings present
+* ``2`` — usage / environment error (bad path, unparseable source,
+  malformed baseline, unknown rule)
+* ``3`` — findings all baselined, but stale baseline entries remain
+  (fixed code must shed its exceptions)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .baseline import BaselineError, write_baseline
+from .findings import RULE_IDS, LintReport
+from .runner import LintConfig, collect_findings, run_lint
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="bkwlint",
+        description="AST invariant linter for backuwup_tpu"
+                    " (BKW001-BKW005)")
+    p.add_argument("package", nargs="?", default=None,
+                   help="package root to lint (default: the repo's"
+                        " backuwup_tpu tree)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline JSON (default: repo"
+                        " .bkwlint-baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="BKW00N",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--doc", default=None, metavar="FILE",
+                   help="metrics catalog markdown (default: repo"
+                        " docs/observability.md)")
+    p.add_argument("--write-baseline", default=None, metavar="FILE",
+                   help="write current findings as a baseline to FILE"
+                        " (placeholder justifications — edit before"
+                        " committing) and exit 0")
+    return p
+
+
+def _config(args) -> LintConfig:
+    repo = Path(__file__).resolve().parents[2]
+    cfg = LintConfig.for_repo(repo)
+    if args.package is not None:
+        cfg.package_root = Path(args.package)
+        if args.doc is None:
+            cfg.doc_path = None  # foreign tree: no implicit repo catalog
+        if args.baseline is None:
+            cfg.baseline_path = None
+    if args.doc is not None:
+        cfg.doc_path = Path(args.doc)
+    if args.baseline is not None:
+        cfg.baseline_path = Path(args.baseline)
+    if args.no_baseline:
+        cfg.baseline_path = None
+    if args.rule:
+        cfg.rules = {r.upper() for r in args.rule}
+    return cfg
+
+
+def _render_text(report: LintReport, out) -> None:
+    for f in report.findings:
+        print(f.render(), file=out)
+    for entry in report.stale_baseline:
+        print(f"baseline: stale entry {entry['key']!r} matches no"
+              f" current finding — remove it", file=out)
+    n, b, s = (len(report.findings), len(report.baselined),
+               len(report.stale_baseline))
+    print(f"bkwlint: {n} finding(s), {b} baselined, {s} stale"
+          f" baseline entr{'y' if s == 1 else 'ies'}", file=out)
+
+
+def main(argv: Optional[List[str]] = None, out=None) -> int:
+    out = out or sys.stdout
+    args = _build_parser().parse_args(argv)
+    cfg = _config(args)
+    if cfg.rules is not None:
+        unknown = cfg.rules - set(RULE_IDS)
+        if unknown:
+            print(f"bkwlint: unknown rule(s): {sorted(unknown)}"
+                  f" (have: {sorted(RULE_IDS)})", file=sys.stderr)
+            return 2
+    if not Path(cfg.package_root).is_dir():
+        print(f"bkwlint: package root not found: {cfg.package_root}",
+              file=sys.stderr)
+        return 2
+
+    try:
+        if args.write_baseline:
+            findings = collect_findings(cfg)
+            write_baseline(Path(args.write_baseline), findings,
+                           "TODO: justify this exception")
+            print(f"bkwlint: wrote {len(findings)} entr"
+                  f"{'y' if len(findings) == 1 else 'ies'} to"
+                  f" {args.write_baseline}", file=out)
+            return 0
+        report = run_lint(cfg)
+    except (SyntaxError, BaselineError, OSError) as e:
+        print(f"bkwlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        json.dump(report.to_dict(), out, indent=2)
+        out.write("\n")
+    else:
+        _render_text(report, out)
+    if report.findings:
+        return 1
+    if report.stale_baseline:
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""Finding model for the bkwlint static-analysis toolkit.
+
+Every rule reports :class:`Finding` records with a **stable key** —
+``rule:path:anchor`` where the anchor is derived from *what* the finding
+is about (function qualname, metric family, enum member), never from a
+line number.  Keys are what the baseline file matches on, so an
+unrelated edit that shifts lines can neither silence a real finding nor
+resurrect a baselined one.
+
+Severities:
+
+* ``error`` — the invariant the codebase promises is broken; the gate
+  fails.
+* ``warning`` — the rule fired on a heuristic resolution (e.g. a
+  lock-ish name it could not trace to ``threading.Lock``); still gated,
+  but the message says why confidence is lower.
+
+The rule-id registry lives here so ``--rule`` filtering and docs have
+one source of truth.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+#: rule id -> one-line summary (the catalog docs/analysis.md renders)
+RULE_IDS: Dict[str, str] = {
+    "BKW001": "no blocking I/O reachable from an async def off the"
+              " executor seam",
+    "BKW002": "no await while holding a threading.Lock/RLock",
+    "BKW003": "every durable-commit seam has a crashpoint and the"
+              " crash-site registry is exact",
+    "BKW004": "every constructed bkw_* metric family is cataloged (and"
+              " vice versa) with consistent labels",
+    "BKW005": "every RequestType/P2PBodyKind member has a live"
+              " serve/dispatch arm in net/p2p.py",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one location."""
+
+    rule: str
+    severity: str
+    path: str  # package-relative, e.g. "net/p2p.py" (or "docs/...")
+    line: int
+    message: str
+    anchor: str  # line-independent identity within (rule, path)
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.anchor}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line,
+                "message": self.message, "key": self.key}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule}"
+                f" {self.severity}: {self.message}")
+
+
+@dataclass
+class LintReport:
+    """The runner's output: active findings plus baseline bookkeeping."""
+
+    findings: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[dict] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.stale_baseline
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "findings": [f.to_dict() for f in self.findings],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "stale_baseline": list(self.stale_baseline),
+            "clean": self.clean,
+        }

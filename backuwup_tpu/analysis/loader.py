@@ -1,0 +1,194 @@
+"""Shared module loader for bkwlint: parse a package tree once.
+
+Every rule consumes the same :class:`Package` — one ``ast`` parse per
+file, package-relative module names, an import map (who calls ``wire``
+what), and the module-level *simple constants* (strings and tuples of
+strings) that the codebase uses for crash-site names and metric label
+sets.  Nothing here imports the analyzed code; the toolkit must be able
+to lint a tree that does not import (that is half the point).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+#: sentinel module name for imports that leave the analyzed package
+EXTERNAL = "<external>"
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file of the analyzed package."""
+
+    path: Path
+    rel: str  # e.g. "net/p2p.py"
+    name: str  # package-relative dotted name, "" for the root __init__
+    tree: ast.Module
+    #: local alias -> package-relative dotted module name, or EXTERNAL
+    imports: Dict[str, str] = field(default_factory=dict)
+    #: imported name -> (package-relative module, attribute name)
+    from_imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    #: module-level NAME = "str" / ("a", "b") constant bindings
+    constants: Dict[str, object] = field(default_factory=dict)
+
+    def source_line(self, node: ast.AST) -> int:
+        return getattr(node, "lineno", 1)
+
+
+@dataclass
+class Package:
+    root: Path
+    name: str  # top-level package name (root directory name)
+    modules: Dict[str, ModuleInfo] = field(default_factory=dict)  # by rel
+
+    def by_name(self, dotted: str) -> Optional[ModuleInfo]:
+        return self._by_name.get(dotted)
+
+    def __post_init__(self):
+        self._by_name: Dict[str, ModuleInfo] = {}
+
+    def _index(self) -> None:
+        self._by_name = {m.name: m for m in self.modules.values()}
+
+
+def _module_name(root: Path, path: Path) -> str:
+    rel = path.relative_to(root)
+    parts = list(rel.parts)
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    else:
+        parts[-1] = parts[-1][:-3]
+    return ".".join(parts)
+
+
+def _package_parts(mod: ModuleInfo) -> List[str]:
+    """The package a module lives in (itself, for ``__init__`` files)."""
+    if mod.path.name == "__init__.py":
+        return mod.name.split(".") if mod.name else []
+    parts = mod.name.split(".")
+    return parts[:-1]
+
+
+def _resolve_relative(mod: ModuleInfo, level: int,
+                      target: str) -> Optional[str]:
+    """``from <level dots><target> import ...`` -> package-relative name
+    (None when the import climbs out of the analyzed package)."""
+    base = _package_parts(mod)
+    if level > len(base) + 1:
+        return None
+    if level:
+        base = base[:len(base) - (level - 1)]
+    parts = base + ([p for p in target.split(".") if p] if target else [])
+    return ".".join(parts)
+
+
+def _collect_imports(pkg: Package, mod: ModuleInfo) -> None:
+    for node in mod.tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                top = alias.name
+                if top == pkg.name or top.startswith(pkg.name + "."):
+                    inner = top[len(pkg.name):].lstrip(".")
+                    mod.imports[local] = inner
+                else:
+                    mod.imports[local] = EXTERNAL + ":" + alias.name
+        elif isinstance(node, ast.ImportFrom):
+            target = node.module or ""
+            if node.level:
+                resolved = _resolve_relative(mod, node.level, target)
+            elif target == pkg.name or target.startswith(pkg.name + "."):
+                resolved = target[len(pkg.name):].lstrip(".")
+            else:
+                resolved = None
+            for alias in node.names:
+                local = alias.asname or alias.name
+                if resolved is None:
+                    mod.imports.setdefault(
+                        local, EXTERNAL + ":" + target)
+                    continue
+                sub = (resolved + "." + alias.name).lstrip(".")
+                if pkg.by_name(sub) is not None:
+                    # `from .utils import durable` style: a submodule
+                    mod.imports[local] = sub
+                else:
+                    mod.from_imports[local] = (resolved, alias.name)
+
+
+def _collect_constants(mod: ModuleInfo) -> None:
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        value = node.value
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            mod.constants[tgt.id] = value.value
+        elif isinstance(value, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in value.elts):
+            mod.constants[tgt.id] = tuple(e.value for e in value.elts)
+
+
+def load_package(root: Path) -> Package:
+    """Parse every ``*.py`` under ``root`` (skipping caches) into a
+    :class:`Package`.  Raises ``SyntaxError`` with the offending path in
+    the message when a file does not parse — an unparseable tree cannot
+    be linted and must fail loudly."""
+    root = Path(root).resolve()
+    pkg = Package(root=root, name=root.name)
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = str(path.relative_to(root))
+        try:
+            tree = ast.parse(path.read_text(), filename=rel)
+        except SyntaxError as e:
+            raise SyntaxError(f"{rel}: {e}") from e
+        pkg.modules[rel] = ModuleInfo(
+            path=path, rel=rel, name=_module_name(root, path), tree=tree)
+    pkg._index()
+    for mod in pkg.modules.values():
+        _collect_imports(pkg, mod)
+        _collect_constants(mod)
+    return pkg
+
+
+def dotted_repr(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_str_arg(mod: ModuleInfo, node: ast.AST) -> Optional[str]:
+    """A string literal, or a module-level constant holding one."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        v = mod.constants.get(node.id)
+        if isinstance(v, str):
+            return v
+    return None
+
+
+def resolve_strs_arg(mod: ModuleInfo, node: ast.AST) -> Optional[tuple]:
+    """A tuple/list of string literals, or a constant holding one."""
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    if isinstance(node, ast.Name):
+        v = mod.constants.get(node.id)
+        if isinstance(v, tuple):
+            return v
+    return None

@@ -1,0 +1,225 @@
+"""BKW001 / BKW002: event-loop purity rules.
+
+**BKW001 — no blocking I/O reachable from an async def.**  The static
+twin of the swarm harness's ``commits_off_event_loop`` gate: walk the
+call-graph from every ``async def`` body and flag any path that reaches
+a blocking primitive (``time.sleep``, ``os.fsync``/``fdatasync``,
+``sqlite3.*``, ``subprocess.*``, builtin ``open``, and the pathlib
+``read_*``/``write_*`` helpers this codebase uses for file I/O) unless
+the call is routed through the executor seam (``Engine._blocking``,
+``loop.run_in_executor``, ``asyncio.to_thread``).  Closures handed TO
+the executor are sync functions that are never *called* from the async
+body, so the graph naturally keeps them off the loop's account.
+
+One finding per (blocking call site, nearest async root) — anchored at
+the blocking site so the key survives refactors of the async caller's
+internals.
+
+**BKW002 — no await while holding a threading lock.**  A lexical rule:
+an ``await`` (or ``async with``/``async for``) inside a plain ``with``
+block whose context manager is a ``threading.Lock``/``RLock`` parks the
+coroutine while every OTHER thread — and any other task that touches
+the same lock via sync code — blocks.  Resolution: the context
+expression's assignment is traced to ``threading.Lock()``/``RLock()``
+(error), or merely *smells* like a lock by name (warning); asyncio
+primitives, which must be entered with ``async with`` anyway, never
+match.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .callgraph import CallGraph, CallSite, FuncInfo
+from .findings import SEV_ERROR, SEV_WARNING, Finding
+
+#: exact dotted forms that run their payload off the event loop
+EXECUTOR_SEAM_SUFFIXES = ("._blocking", ".run_in_executor", ".to_thread")
+
+#: pathlib-style attribute calls that hit the disk whoever the receiver
+BLOCKING_ATTRS = ("read_bytes", "write_bytes", "read_text", "write_text")
+
+#: dotted-prefix -> category for module-level blocking primitives
+BLOCKING_PREFIXES = (("sqlite3.", "sqlite3"), ("subprocess.", "subprocess"))
+
+BLOCKING_EXACT = {
+    "time.sleep": "time.sleep",
+    "os.fsync": "os.fsync",
+    "os.fdatasync": "os.fdatasync",
+    "open": "open",
+}
+
+
+def _is_executor_seam(cs: CallSite) -> bool:
+    return any(cs.norm.endswith(s) for s in EXECUTOR_SEAM_SUFFIXES) \
+        or cs.norm in ("to_thread", "run_in_executor")
+
+
+def _blocking_category(cs: CallSite) -> Optional[str]:
+    if _is_executor_seam(cs):
+        return None
+    cat = BLOCKING_EXACT.get(cs.norm)
+    if cat:
+        return cat
+    for prefix, name in BLOCKING_PREFIXES:
+        if cs.norm.startswith(prefix) or cs.norm == prefix[:-1]:
+            return name
+    tail = cs.norm.rsplit(".", 1)[-1]
+    if "." in cs.norm and tail in BLOCKING_ATTRS:
+        return tail
+    return None
+
+
+def _direct_blocking(fn: FuncInfo) -> List[Tuple[CallSite, str]]:
+    return [(cs, cat) for cs in fn.calls
+            for cat in (_blocking_category(cs),) if cat]
+
+
+def check_bkw001(graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    reported = set()  # (blocking fid, call line-agnostic anchor)
+    for root in sorted(graph.async_functions(), key=lambda f: f.fid):
+        parents = graph.reachable_from(root.fid, skip_call=_is_executor_seam)
+        for fid in [root.fid] + sorted(parents):
+            holder = graph.functions.get(fid)
+            if holder is None:
+                continue
+            for cs, cat in _direct_blocking(holder):
+                anchor = f"{holder.qualname}->{cs.repr}"
+                dedup = (holder.fid, cs.repr)
+                if dedup in reported:
+                    continue
+                reported.add(dedup)
+                chain = graph.chain(root.fid, fid, parents)
+                via = " -> ".join(chain) if len(chain) > 1 \
+                    else holder.qualname
+                findings.append(Finding(
+                    rule="BKW001", severity=SEV_ERROR,
+                    path=holder.module.rel, line=cs.node.lineno,
+                    message=(
+                        f"blocking call '{cs.repr}' ({cat}) reachable"
+                        f" from async '{root.qualname}' via {via};"
+                        f" route it through Engine._blocking /"
+                        f" run_in_executor / asyncio.to_thread"),
+                    anchor=anchor))
+    return findings
+
+
+# --- BKW002 -----------------------------------------------------------------
+
+_THREADING_LOCKS = ("threading.Lock", "threading.RLock")
+_ASYNC_LOCKS = ("asyncio.Lock", "asyncio.Condition", "asyncio.Semaphore")
+
+
+def _lock_kind(graph: CallGraph, fn: FuncInfo,
+               expr: ast.AST) -> Optional[Tuple[str, str]]:
+    """(severity, description) when ``with expr`` takes a threading
+    lock; None for asyncio primitives and non-lock-ish expressions."""
+    if isinstance(expr, ast.Call):
+        rep = _norm(graph, fn, expr.func)
+        if rep in _THREADING_LOCKS:
+            return SEV_ERROR, rep
+        return None
+    rep_raw = _norm(graph, fn, expr, raw=True)
+    if rep_raw is None:
+        return None
+    assigned = _trace_lock_assignment(graph, fn, expr)
+    if assigned in _THREADING_LOCKS:
+        return SEV_ERROR, assigned
+    if assigned in _ASYNC_LOCKS:
+        return None
+    if "lock" in rep_raw.rsplit(".", 1)[-1].lower():
+        return SEV_WARNING, f"'{rep_raw}' (lock-like name, unresolved)"
+    return None
+
+
+def _norm(graph: CallGraph, fn: FuncInfo, node: ast.AST, raw=False):
+    from .loader import dotted_repr
+    rep = dotted_repr(node)
+    if rep is None:
+        return None
+    return rep if raw else graph._normalize(fn.module, rep)
+
+
+def _trace_lock_assignment(graph: CallGraph, fn: FuncInfo,
+                           expr: ast.AST) -> Optional[str]:
+    """What ``expr`` was assigned: 'threading.Lock' etc., or None."""
+    def value_kind(v: ast.AST) -> Optional[str]:
+        if isinstance(v, ast.Call):
+            return _norm(graph, fn, v.func)
+        return None
+
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+            and fn.cls and fn.cls in graph.classes:
+        for cid in graph._class_family(fn.cls):
+            cls = graph.classes[cid]
+            for item in cls.node.body:
+                for n in ast.walk(item):
+                    if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                            and isinstance(n.targets[0], ast.Attribute) \
+                            and n.targets[0].attr == expr.attr:
+                        kind = value_kind(n.value)
+                        if kind:
+                            return kind
+        return None
+    if isinstance(expr, ast.Name):
+        for n in graph.body_nodes(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and n.targets[0].id == expr.id:
+                kind = value_kind(n.value)
+                if kind:
+                    return kind
+        for n in fn.module.tree.body:
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and n.targets[0].id == expr.id:
+                kind = value_kind(n.value)
+                if kind:
+                    return kind
+    return None
+
+
+def _awaits_inside(graph: CallGraph, with_node: ast.With) -> List[ast.AST]:
+    out = []
+    stack = [n for item in with_node.body for n in [item]]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, (ast.Await, ast.AsyncWith, ast.AsyncFor)):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def check_bkw002(graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in sorted(graph.functions.values(), key=lambda f: f.fid):
+        if not fn.is_async:
+            continue
+        for node in graph.body_nodes(fn):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                kind = _lock_kind(graph, fn, item.context_expr)
+                if kind is None:
+                    continue
+                severity, desc = kind
+                awaits = _awaits_inside(graph, node)
+                if not awaits:
+                    continue
+                from .loader import dotted_repr
+                lock_rep = dotted_repr(item.context_expr) or "<lock>"
+                findings.append(Finding(
+                    rule="BKW002", severity=severity,
+                    path=fn.module.rel, line=awaits[0].lineno,
+                    message=(
+                        f"await inside 'with {lock_rep}' in"
+                        f" '{fn.qualname}' holds a threading lock"
+                        f" ({desc}) across a suspension point"),
+                    anchor=f"{fn.qualname}:{lock_rep}"))
+    return findings

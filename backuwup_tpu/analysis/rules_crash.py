@@ -1,0 +1,204 @@
+"""BKW003: crash-seam coverage — the static crash matrix.
+
+Replaces the grep-based completeness test (tests/test_gc.py) with an
+AST account of the same CrashMonkey/ALICE posture: the set of places a
+crash can be *injected* must exactly cover the set of places a crash
+can *hurt*.
+
+Three checks:
+
+1. **Registry exactness.**  Every ``faults.crashpoint(X)`` argument must
+   resolve — a string literal, or a module-level constant bound by
+   ``X = faults.register_crash_site("...")`` — to a registered site, and
+   every registered site must have at least one call site (a registered
+   seam nobody calls is a dead crash-matrix entry).
+2. **Commit-seam coverage.**  Every call to the fsync-disciplined
+   helpers ``durable.commit_replace`` / ``durable.write_replace`` and
+   every ``index.flush()`` seam must have a crashpoint *adjacent*:
+   lexically in the same function, inside the callee it invokes (the
+   ``BlobIndex.flush -> save`` case, via the call-graph), or in a direct
+   caller (the closure-staged-on-the-executor idiom,
+   ``sink_part.stage -> PartialStore.append``).  A commit with no
+   injectable crash next to it is a seam the matrix cannot exercise.
+3. Unresolvable ``crashpoint(<expr>)`` arguments are findings too — a
+   dynamic site name cannot be enumerated.
+
+The fault plane itself (``utils/faults.py``) is exempt: it *defines*
+the hooks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, FuncInfo
+from .findings import SEV_ERROR, Finding
+from .loader import resolve_str_arg
+
+FAULTS_MODULE = "utils/faults.py"
+
+#: the helper layer itself is not a seam — ``write_replace`` calling
+#: ``commit_replace`` is composition, not a commit site; coverage is
+#: checked where application code invokes the helpers
+DURABLE_MODULE = "utils/durable.py"
+
+#: durable-commit helper tails (module-qualified or from-imported)
+COMMIT_HELPERS = ("commit_replace", "write_replace")
+
+
+def _is_crashpoint(norm: str) -> bool:
+    return norm == "crashpoint" or norm.endswith(".crashpoint")
+
+
+def _is_register(node: ast.Call, norm: str) -> bool:
+    return norm == "register_crash_site" \
+        or norm.endswith(".register_crash_site")
+
+
+def collect_registry(graph: CallGraph) -> Tuple[
+        Dict[str, Tuple[str, int]], Dict[str, Dict[str, str]]]:
+    """(site -> (rel, line) of registration,
+    module rel -> {const name -> site literal})."""
+    registered: Dict[str, Tuple[str, int]] = {}
+    consts: Dict[str, Dict[str, str]] = {}
+    for mod in graph.pkg.modules.values():
+        if mod.rel == FAULTS_MODULE:
+            continue
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt, v = node.targets[0], node.value
+            if not (isinstance(tgt, ast.Name) and isinstance(v, ast.Call)):
+                continue
+            from .loader import dotted_repr
+            rep = dotted_repr(v.func)
+            if rep is None or not _is_register(v, rep):
+                continue
+            site = resolve_str_arg(mod, v.args[0]) if v.args else None
+            if site is not None:
+                registered[site] = (mod.rel, node.lineno)
+                consts.setdefault(mod.rel, {})[tgt.id] = site
+    return registered, consts
+
+
+def _crashpoint_site(graph: CallGraph, fn: FuncInfo, call_args: list,
+                     consts: Dict[str, Dict[str, str]]) -> Optional[str]:
+    if not call_args:
+        return None
+    arg = call_args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.Name):
+        return consts.get(fn.module.rel, {}).get(arg.id)
+    return None
+
+
+def _has_lexical_crashpoint(fn: FuncInfo) -> bool:
+    return any(_is_crashpoint(cs.norm) for cs in fn.calls)
+
+
+def _callee_has_crashpoint(graph: CallGraph, fid: str,
+                           depth: int = 6) -> bool:
+    """Crashpoint anywhere in ``fid``'s body or its in-package callees."""
+    seen: Set[str] = set()
+    stack = [(fid, 0)]
+    while stack:
+        cur, d = stack.pop()
+        if cur in seen or d > depth:
+            continue
+        seen.add(cur)
+        info = graph.functions.get(cur)
+        if info is None:
+            continue
+        if _has_lexical_crashpoint(info):
+            return True
+        stack.extend((cs.target, d + 1) for cs in info.calls if cs.target)
+    return False
+
+
+def _is_commit_seam(cs) -> Optional[str]:
+    """'durable-helper' / 'index-flush' when the call is a commit seam."""
+    parts = cs.norm.split(".")
+    if parts[-1] in COMMIT_HELPERS:
+        return f"durable.{parts[-1]}"
+    if parts[-1] == "flush" and len(parts) >= 2 \
+            and parts[-2].endswith("index"):
+        return "index.flush"
+    return None
+
+
+def check_bkw003(graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    registered, consts = collect_registry(graph)
+    called_sites: Dict[str, List[Tuple[str, int]]] = {}
+
+    for fn in sorted(graph.functions.values(), key=lambda f: f.fid):
+        if fn.module.rel == FAULTS_MODULE:
+            continue
+        for cs in fn.calls:
+            if _is_crashpoint(cs.norm):
+                site = _crashpoint_site(graph, fn, cs.node.args, consts)
+                if site is None:
+                    findings.append(Finding(
+                        rule="BKW003", severity=SEV_ERROR,
+                        path=fn.module.rel, line=cs.node.lineno,
+                        message=(
+                            f"crashpoint argument in '{fn.qualname}'"
+                            f" does not resolve to a"
+                            f" register_crash_site literal — the crash"
+                            f" matrix cannot enumerate it"),
+                        anchor=f"unresolved:{fn.qualname}"))
+                else:
+                    called_sites.setdefault(site, []).append(
+                        (fn.module.rel, cs.node.lineno))
+                continue
+            seam = _is_commit_seam(cs)
+            if seam is None or fn.module.rel == DURABLE_MODULE:
+                continue
+            covered = _has_lexical_crashpoint(fn)
+            if not covered and cs.target and seam == "index.flush":
+                covered = _callee_has_crashpoint(graph, cs.target)
+            if not covered:
+                covered = any(
+                    _has_lexical_crashpoint(graph.functions[c])
+                    for c in graph.callers_of(fn.fid)
+                    if c in graph.functions)
+            if not covered:
+                findings.append(Finding(
+                    rule="BKW003", severity=SEV_ERROR,
+                    path=fn.module.rel, line=cs.node.lineno,
+                    message=(
+                        f"commit seam '{cs.repr}' ({seam}) in"
+                        f" '{fn.qualname}' has no faults.crashpoint in"
+                        f" the same function, its callee, or a direct"
+                        f" caller — the crash matrix cannot exercise"
+                        f" this commit"),
+                    anchor=f"seam:{fn.qualname}:{cs.repr}"))
+
+    for site, (rel, line) in sorted(registered.items()):
+        if site not in called_sites:
+            findings.append(Finding(
+                rule="BKW003", severity=SEV_ERROR,
+                path=rel, line=line,
+                message=(f"crash site '{site}' is registered but never"
+                         f" passed to faults.crashpoint — a dead"
+                         f" crash-matrix entry"),
+                anchor=f"dead-site:{site}"))
+    for site, locs in sorted(called_sites.items()):
+        if site not in registered:
+            rel, line = locs[0]
+            findings.append(Finding(
+                rule="BKW003", severity=SEV_ERROR,
+                path=rel, line=line,
+                message=(f"crashpoint site '{site}' has no"
+                         f" register_crash_site declaration — it would"
+                         f" escape faults.crash_sites()"),
+                anchor=f"unregistered-site:{site}"))
+    return findings
+
+
+def static_crash_sites(graph: CallGraph) -> Set[str]:
+    """The statically enumerated registry (parity hook for tests)."""
+    registered, _ = collect_registry(graph)
+    return set(registered)

@@ -1,0 +1,252 @@
+"""BKW004 / BKW005: drift rules — code vs catalog, enum vs dispatch.
+
+**BKW004 — metrics-catalog sync.**  The ``bkw_*`` families the code
+registers through the ``obs/metrics.py`` get-or-create constructors and
+the rows of ``docs/observability.md``'s Catalog table must agree both
+ways, and every call site of one family must declare the same label
+set (the runtime registry raises on conflict — this rule catches it
+before an import ever runs, and catches the silent case the runtime
+cannot: a family nobody documents).
+
+The doc side is parsed from the Catalog's markdown table: any
+backticked ``bkw_*`` token in a table row is a documented family; the
+backticked tokens of the Labels column are its documented label set.
+
+**BKW005 — wire-handler exhaustiveness.**  Every member of
+``RequestType`` / ``P2PBodyKind`` in ``wire.py`` must be referenced in
+``net/p2p.py`` (a member without a serve/dispatch arm is dead protocol
+surface the serve loop will drop on the floor), and every
+``<Enum>.<MEMBER>`` attribute reference anywhere in the package must
+name a live member (a dead member would only fail at runtime, on the
+rare path that takes it).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph
+from .findings import SEV_ERROR, SEV_WARNING, Finding
+from .loader import dotted_repr, resolve_strs_arg
+
+METRIC_CTORS = ("counter", "gauge", "histogram")
+_DOC_FAMILY_RE = re.compile(r"`(bkw_[a-zA-Z0-9_]+)`")
+_DOC_LABEL_RE = re.compile(r"`([a-zA-Z_][a-zA-Z0-9_]*)`")
+
+
+# --- BKW004 -----------------------------------------------------------------
+
+
+def collect_metric_families(graph: CallGraph) -> Dict[str, List[dict]]:
+    """family name -> construction sites [{rel, line, kind, labels}]."""
+    out: Dict[str, List[dict]] = {}
+    for mod in graph.pkg.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            rep = dotted_repr(node.func)
+            if rep is None:
+                continue
+            tail = rep.rsplit(".", 1)[-1]
+            if tail not in METRIC_CTORS or not node.args:
+                continue
+            a0 = node.args[0]
+            if not (isinstance(a0, ast.Constant)
+                    and isinstance(a0.value, str)
+                    and a0.value.startswith("bkw_")):
+                continue
+            labels: Optional[tuple] = ()
+            if len(node.args) >= 3:
+                labels = resolve_strs_arg(mod, node.args[2])
+            for kw in node.keywords:
+                if kw.arg == "labelnames":
+                    labels = resolve_strs_arg(mod, kw.value)
+            out.setdefault(a0.value, []).append({
+                "rel": mod.rel, "line": node.lineno, "kind": tail,
+                "labels": labels})
+    return out
+
+
+def parse_catalog(doc_path: Path) -> Dict[str, dict]:
+    """family -> {line, labels} from the markdown Catalog table."""
+    out: Dict[str, dict] = {}
+    for i, raw in enumerate(doc_path.read_text().splitlines(), start=1):
+        line = raw.strip()
+        if not line.startswith("|") or line.startswith("|---") \
+                or line.startswith("| Metric"):
+            continue
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        if len(cells) < 4:
+            continue
+        fams = _DOC_FAMILY_RE.findall(cells[0])
+        if not fams:
+            continue
+        labels = tuple(_DOC_LABEL_RE.findall(cells[2]))
+        for fam in fams:
+            out.setdefault(fam, {"line": i, "labels": labels})
+    return out
+
+
+def check_bkw004(graph: CallGraph,
+                 doc_path: Optional[Path]) -> List[Finding]:
+    findings: List[Finding] = []
+    families = collect_metric_families(graph)
+
+    for fam, sites in sorted(families.items()):
+        label_sets = {s["labels"] for s in sites}
+        kinds = {s["kind"] for s in sites}
+        if len(label_sets) > 1 or len(kinds) > 1:
+            where = ", ".join(f"{s['rel']}:{s['line']}"
+                              f" {s['kind']}{s['labels']}" for s in sites)
+            findings.append(Finding(
+                rule="BKW004", severity=SEV_ERROR,
+                path=sites[0]["rel"], line=sites[0]["line"],
+                message=(f"metric family '{fam}' constructed with"
+                         f" conflicting type/label sets: {where} —"
+                         f" obs.metrics raises MetricError at import"),
+                anchor=f"conflict:{fam}"))
+        if None in label_sets:
+            findings.append(Finding(
+                rule="BKW004", severity=SEV_WARNING,
+                path=sites[0]["rel"], line=sites[0]["line"],
+                message=(f"metric family '{fam}' label set is not"
+                         f" statically resolvable — use a literal"
+                         f" tuple or a module-level constant"),
+                anchor=f"dynamic-labels:{fam}"))
+
+    if doc_path is None or not Path(doc_path).exists():
+        if families:
+            findings.append(Finding(
+                rule="BKW004", severity=SEV_ERROR, path="docs",
+                line=1, message=("metrics catalog document not found;"
+                                 " cannot check bkw_* family sync"),
+                anchor="missing-catalog"))
+        return findings
+
+    doc = parse_catalog(Path(doc_path))
+    doc_rel = Path(doc_path).name
+    for fam, sites in sorted(families.items()):
+        if fam not in doc:
+            findings.append(Finding(
+                rule="BKW004", severity=SEV_ERROR,
+                path=sites[0]["rel"], line=sites[0]["line"],
+                message=(f"metric family '{fam}' is registered but has"
+                         f" no row in the {doc_rel} catalog"),
+                anchor=f"undocumented:{fam}"))
+            continue
+        code_labels = next(iter(ls for ls in
+                                {s["labels"] for s in sites}
+                                if ls is not None), ())
+        doc_labels = doc[fam]["labels"]
+        if set(doc_labels) != set(code_labels):
+            findings.append(Finding(
+                rule="BKW004", severity=SEV_ERROR,
+                path=f"docs/{doc_rel}", line=doc[fam]["line"],
+                message=(f"catalog row for '{fam}' documents labels"
+                         f" {tuple(doc_labels)} but the code constructs"
+                         f" it with {tuple(code_labels)}"),
+                anchor=f"label-drift:{fam}"))
+    for fam, info in sorted(doc.items()):
+        if fam not in families:
+            findings.append(Finding(
+                rule="BKW004", severity=SEV_ERROR,
+                path=f"docs/{doc_rel}", line=info["line"],
+                message=(f"catalog documents '{fam}' but no code"
+                         f" constructs that family — prune the row or"
+                         f" restore the metric"),
+                anchor=f"unconstructed:{fam}"))
+    return findings
+
+
+# --- BKW005 -----------------------------------------------------------------
+
+WIRE_MODULE = "wire.py"
+HANDLER_MODULE = "net/p2p.py"
+CHECKED_ENUMS = ("RequestType", "P2PBodyKind")
+_ENUM_BASES = ("IntEnum", "Enum", "IntFlag")
+
+
+def collect_enums(graph: CallGraph) -> Dict[str, Dict[str, int]]:
+    """enum name -> {member -> line} from the wire module."""
+    wire = graph.pkg.modules.get(WIRE_MODULE)
+    out: Dict[str, Dict[str, int]] = {}
+    if wire is None:
+        return out
+    for node in wire.tree.body:
+        if not isinstance(node, ast.ClassDef) \
+                or node.name not in CHECKED_ENUMS:
+            continue
+        bases = {dotted_repr(b) for b in node.bases}
+        if not any(b and b.rsplit(".", 1)[-1] in _ENUM_BASES
+                   for b in bases):
+            continue
+        members: Dict[str, int] = {}
+        for item in node.body:
+            if isinstance(item, ast.Assign) \
+                    and len(item.targets) == 1 \
+                    and isinstance(item.targets[0], ast.Name) \
+                    and not item.targets[0].id.startswith("_"):
+                members[item.targets[0].id] = item.lineno
+        out[node.name] = members
+    return out
+
+
+def collect_enum_refs(graph: CallGraph) -> Dict[
+        Tuple[str, str], List[Tuple[str, int]]]:
+    """(enum, member) -> [(rel, line)] attribute references anywhere."""
+    refs: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+    for mod in graph.pkg.modules.values():
+        if mod.rel == WIRE_MODULE:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            rep = dotted_repr(node)
+            if rep is None:
+                continue
+            parts = rep.split(".")
+            if len(parts) < 2:
+                continue
+            enum, member = parts[-2], parts[-1]
+            if enum in CHECKED_ENUMS and member.isupper():
+                refs.setdefault((enum, member), []).append(
+                    (mod.rel, node.lineno))
+    return refs
+
+
+def check_bkw005(graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    enums = collect_enums(graph)
+    if not enums:
+        return findings  # fixture package without a wire module
+    refs = collect_enum_refs(graph)
+
+    handler_refs: Set[Tuple[str, str]] = set()
+    for (enum, member), locs in refs.items():
+        if any(rel == HANDLER_MODULE for rel, _ in locs):
+            handler_refs.add((enum, member))
+
+    for enum, members in sorted(enums.items()):
+        for member, line in sorted(members.items()):
+            if (enum, member) not in handler_refs:
+                findings.append(Finding(
+                    rule="BKW005", severity=SEV_ERROR,
+                    path=WIRE_MODULE, line=line,
+                    message=(f"wire enum member {enum}.{member} has no"
+                             f" serve/dispatch arm in {HANDLER_MODULE}"
+                             f" — dead protocol surface"),
+                    anchor=f"unhandled:{enum}.{member}"))
+    for (enum, member), locs in sorted(refs.items()):
+        if enum in enums and member not in enums[enum]:
+            rel, line = locs[0]
+            findings.append(Finding(
+                rule="BKW005", severity=SEV_ERROR,
+                path=rel, line=line,
+                message=(f"reference to {enum}.{member} names a member"
+                         f" that does not exist in {WIRE_MODULE} —"
+                         f" AttributeError on this code path"),
+                anchor=f"dead-member:{enum}.{member}"))
+    return findings

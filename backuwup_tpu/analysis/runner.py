@@ -1,0 +1,89 @@
+"""bkwlint runner: load once, build the graph once, run every rule.
+
+The orchestration layer the CLI, the tier-1 gate, and the fixture tests
+all share.  ``run_lint`` is pure — paths in, :class:`LintReport` out —
+so tests can point it at throwaway fixture packages and the CLI at the
+real tree with identical semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Set
+
+from .baseline import apply_baseline, load_baseline
+from .callgraph import CallGraph, build_graph
+from .findings import RULE_IDS, Finding, LintReport
+from .loader import Package, load_package
+from .rules_async import check_bkw001, check_bkw002
+from .rules_crash import check_bkw003
+from .rules_drift import check_bkw004, check_bkw005
+
+
+@dataclass
+class LintConfig:
+    package_root: Path
+    doc_path: Optional[Path] = None  # metrics catalog for BKW004
+    baseline_path: Optional[Path] = None
+    rules: Optional[Set[str]] = None  # None = all
+
+    @staticmethod
+    def for_repo(repo_root: Path) -> "LintConfig":
+        """The production configuration: the backuwup_tpu package, its
+        observability catalog, and the checked-in baseline."""
+        repo_root = Path(repo_root)
+        return LintConfig(
+            package_root=repo_root / "backuwup_tpu",
+            doc_path=repo_root / "docs" / "observability.md",
+            baseline_path=repo_root / ".bkwlint-baseline.json")
+
+
+def _rule_table(cfg: LintConfig) -> Dict[str, Callable[[CallGraph],
+                                                       List[Finding]]]:
+    return {
+        "BKW001": check_bkw001,
+        "BKW002": check_bkw002,
+        "BKW003": check_bkw003,
+        "BKW004": lambda g: check_bkw004(g, cfg.doc_path),
+        "BKW005": check_bkw005,
+    }
+
+
+def collect_findings(cfg: LintConfig,
+                     graph: Optional[CallGraph] = None) -> List[Finding]:
+    """All raw findings (pre-baseline), sorted for stable output."""
+    if graph is None:
+        graph = build_graph(load_package(cfg.package_root))
+    selected = cfg.rules or set(RULE_IDS)
+    unknown = selected - set(RULE_IDS)
+    if unknown:
+        raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+    findings: List[Finding] = []
+    for rule_id, check in _rule_table(cfg).items():
+        if rule_id in selected:
+            findings.extend(check(graph))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.anchor))
+    return findings
+
+
+def run_lint(cfg: LintConfig,
+             graph: Optional[CallGraph] = None) -> LintReport:
+    """Findings filtered through the baseline: the gate's entry point."""
+    findings = collect_findings(cfg, graph)
+    baseline = load_baseline(cfg.baseline_path)
+    if cfg.rules is not None:
+        # a rule-filtered run must not call the other rules' baseline
+        # entries stale — they were never given a chance to match
+        baseline = {k: v for k, v in baseline.items()
+                    if k.split(":", 1)[0] in cfg.rules}
+    return apply_baseline(findings, baseline)
+
+
+def load_graph(package_root: Path) -> CallGraph:
+    """Convenience for callers that reuse the graph across runs."""
+    return build_graph(load_package(Path(package_root)))
+
+
+__all__ = ["LintConfig", "collect_findings", "run_lint", "load_graph",
+           "Package", "LintReport"]

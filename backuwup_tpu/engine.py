@@ -1088,7 +1088,7 @@ class Engine:
         try:
             await pack_fut
             orch.packing_completed = True
-            self.index.flush()
+            await self._blocking(self.index.flush)
         except BaseException:
             # BaseException on purpose: an injected CrashInjected (and a
             # cancel of this coroutine) must still tear down the send
@@ -2168,7 +2168,7 @@ class Engine:
         try:
             await pack_fut
             orch.packing_completed = True
-            self.index.flush()
+            await self._blocking(self.index.flush)
         except BaseException:
             # BaseException on purpose: an injected CrashInjected (and a
             # cancel of this coroutine) must still tear down the send

@@ -1,11 +1,22 @@
 #!/bin/sh
-# Container entry: role comes from BKW_ROLE (server|client); extra args
-# pass through to `python -m backuwup_tpu <role>`.
+# Container entry: role comes from BKW_ROLE (server|client|check); extra
+# args pass through to `python -m backuwup_tpu <role>` (or to bkwlint
+# for the check role).
 set -e
-if [ "${BKW_ROLE:-server}" = "server" ]; then
+case "${BKW_ROLE:-server}" in
+server)
     exec python -m backuwup_tpu server \
         --bind "${SERVER_BIND:-0.0.0.0:9999}" \
         --db "${SERVER_DB:-/data/server.db}" "$@"
-else
+    ;;
+check)
+    # static invariant gate (bkwlint): exits 0 clean / 1 findings /
+    # 3 stale baseline — usable as a CI step on the built image
+    exec python -m backuwup_tpu.analysis /app/backuwup_tpu \
+        --doc /app/docs/observability.md \
+        --baseline /app/.bkwlint-baseline.json "$@"
+    ;;
+*)
     exec python -m backuwup_tpu client "$@"
-fi
+    ;;
+esac

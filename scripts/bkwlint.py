@@ -1,0 +1,22 @@
+#!/usr/bin/env python3
+"""bkwlint — AST invariant linter for backuwup_tpu.
+
+Thin launcher over ``backuwup_tpu.analysis.cli`` so the tool runs from
+a checkout without installing the package:
+
+    python scripts/bkwlint.py                 # lint the repo tree
+    python scripts/bkwlint.py --format json   # machine-readable
+    python scripts/bkwlint.py --no-baseline   # show baselined findings
+
+Exit codes: 0 clean / 1 findings / 2 usage error / 3 stale baseline.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from backuwup_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,16 @@ while true; do
 import jax, numpy as np, jax.numpy as jnp
 print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; then
         echo "TPU RECOVERED at $(date)" >> /tmp/tpu_watch.log
+        # pre-capture static gate: a tree that fails bkwlint produces
+        # captures nobody should trust (blocked loops skew every
+        # latency number) — log the findings and refuse to capture
+        if ! python "$REPO_DIR/scripts/bkwlint.py" \
+                >> /tmp/tpu_watch.log 2>&1; then
+            echo "bkwlint FAILED — captures skipped at $(date)" \
+                >> /tmp/tpu_watch.log
+            exit 1
+        fi
+        echo "bkwlint clean at $(date)" >> /tmp/tpu_watch.log
         stamp="$(date -u +%Y%m%dT%H%M%SZ)"
         out="$BENCH_OUT_DIR/BENCH_attempt_${stamp}.json"
         if timeout "${BENCH_TIMEOUT_S:-1800}" \

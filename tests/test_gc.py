@@ -22,7 +22,6 @@ docs/lifecycle.md in unit form — the pieces below the ``gc`` scenario:
 
 import asyncio
 import os
-import re
 import time
 import types
 from pathlib import Path
@@ -650,30 +649,28 @@ def test_recover_rolls_forward_a_half_applied_swap(tmp_path, loop):
         store.close()
 
 
-# --- crash-site registry completeness (the grep test) -----------------------
+# --- crash-site registry completeness (bkwlint BKW003) ----------------------
 
 
-def test_every_crashpoint_call_site_is_registered():
-    """Walk the package tree: every ``faults.crashpoint(<CONST>)`` call
-    must resolve through a ``register_crash_site("...")`` literal in the
-    same module, and the registry must contain exactly those seams — a
-    call site outside the registry would escape the crash matrix, and a
-    registered seam with no call site is a dead matrix entry."""
-    pkg = Path(backuwup_tpu.__file__).parent
-    call_re = re.compile(r"faults\.crashpoint\((\w+)\)")
-    reg_re = re.compile(
-        r"(\w+)\s*=\s*faults\.register_crash_site\(\s*\"([^\"]+)\"\)")
-    called = set()
-    for py in sorted(pkg.rglob("*.py")):
-        if py.name == "faults.py":
-            continue
-        text = py.read_text()
-        consts = dict(reg_re.findall(text))
-        for name in call_re.findall(text):
-            assert name in consts, \
-                f"{py.name}: crashpoint({name}) has no register_crash_site"
-            called.add(consts[name])
-    assert called == set(faults.crash_sites())
+def test_crash_site_registry_is_exact_per_bkw003():
+    """The AST rule supersedes the old grep sweep: every
+    ``faults.crashpoint(<CONST>)`` call resolves through a
+    ``register_crash_site`` literal, every registered seam has a call
+    site, every durable commit has an adjacent crashpoint — and the
+    statically enumerated registry matches the live one exactly (a
+    drift in either direction means the crash matrix and the code
+    disagree about where crashes can be injected)."""
+    from backuwup_tpu.analysis import (load_graph, run_lint, LintConfig,
+                                       static_crash_sites)
+    repo = Path(backuwup_tpu.__file__).parent.parent
+    graph = load_graph(repo / "backuwup_tpu")
+    assert static_crash_sites(graph) == set(faults.crash_sites())
+    cfg = LintConfig.for_repo(repo)
+    cfg.rules = {"BKW003"}
+    report = run_lint(cfg, graph)
+    assert not report.findings, \
+        "\n".join(f.render() for f in report.findings)
+    assert not report.stale_baseline
 
 
 # --- the durability-sweep janitor (satellite: TTL on the monitor loop) ------
