@@ -68,6 +68,13 @@ def apply_baseline(findings: List[Finding],
         if key not in matched:
             report.stale_baseline.append(
                 {"key": key, "justification": why})
+        elif why.strip().startswith("TODO"):
+            # a matched entry still carrying the write-baseline
+            # placeholder is a suppression nobody explained — it gates
+            # exactly like a stale entry (exit 3), because "baselined"
+            # is only meaningful when someone wrote down WHY
+            report.unjustified.append(
+                {"key": key, "justification": why})
     return report
 
 

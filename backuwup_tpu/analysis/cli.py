@@ -2,12 +2,15 @@
 
 Exit-code contract (stable — scripted callers depend on it):
 
-* ``0`` — clean: no unbaselined findings, no stale baseline entries
+* ``0`` — clean: no unbaselined findings, no stale baseline entries,
+  every baselined entry carries a real justification
 * ``1`` — unbaselined findings present
 * ``2`` — usage / environment error (bad path, unparseable source,
   malformed baseline, unknown rule)
-* ``3`` — findings all baselined, but stale baseline entries remain
-  (fixed code must shed its exceptions)
+* ``3`` — findings all baselined, but the baseline itself needs work:
+  stale entries remain (fixed code must shed its exceptions) or an
+  entry's justification still starts with the ``TODO`` placeholder
+  ``--write-baseline`` stamps (a suppression nobody explained)
 """
 
 from __future__ import annotations
@@ -47,6 +50,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="write current findings as a baseline to FILE"
                         " (placeholder justifications — edit before"
                         " committing) and exit 0")
+    p.add_argument("--justification", default=None, metavar="TEXT",
+                   help="with --write-baseline: stamp every entry with"
+                        " TEXT instead of the TODO placeholder (use for"
+                        " a batch of exceptions sharing one real"
+                        " reason; TODO-prefixed entries fail the gate"
+                        " with exit 3 until edited)")
     return p
 
 
@@ -76,10 +85,16 @@ def _render_text(report: LintReport, out) -> None:
     for entry in report.stale_baseline:
         print(f"baseline: stale entry {entry['key']!r} matches no"
               f" current finding — remove it", file=out)
+    for entry in report.unjustified:
+        print(f"baseline: entry {entry['key']!r} still carries the"
+              f" TODO placeholder — write a real justification",
+              file=out)
     n, b, s = (len(report.findings), len(report.baselined),
                len(report.stale_baseline))
+    u = len(report.unjustified)
     print(f"bkwlint: {n} finding(s), {b} baselined, {s} stale"
-          f" baseline entr{'y' if s == 1 else 'ies'}", file=out)
+          f" baseline entr{'y' if s == 1 else 'ies'}, {u} unjustified",
+          file=out)
 
 
 def main(argv: Optional[List[str]] = None, out=None) -> int:
@@ -101,7 +116,8 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         if args.write_baseline:
             findings = collect_findings(cfg)
             write_baseline(Path(args.write_baseline), findings,
-                           "TODO: justify this exception")
+                           args.justification
+                           or "TODO: justify this exception")
             print(f"bkwlint: wrote {len(findings)} entr"
                   f"{'y' if len(findings) == 1 else 'ies'} to"
                   f" {args.write_baseline}", file=out)
@@ -118,7 +134,7 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         _render_text(report, out)
     if report.findings:
         return 1
-    if report.stale_baseline:
+    if report.stale_baseline or report.unjustified:
         return 3
     return 0
 

@@ -73,10 +73,14 @@ class LintReport:
     findings: List[Finding] = field(default_factory=list)
     baselined: List[Finding] = field(default_factory=list)
     stale_baseline: List[dict] = field(default_factory=list)
+    #: matched baseline entries still carrying the write-baseline
+    #: placeholder ("TODO…") — suppressions nobody has justified yet
+    unjustified: List[dict] = field(default_factory=list)
 
     @property
     def clean(self) -> bool:
-        return not self.findings and not self.stale_baseline
+        return (not self.findings and not self.stale_baseline
+                and not self.unjustified)
 
     def to_dict(self) -> dict:
         return {
@@ -84,5 +88,6 @@ class LintReport:
             "findings": [f.to_dict() for f in self.findings],
             "baselined": [f.to_dict() for f in self.baselined],
             "stale_baseline": list(self.stale_baseline),
+            "unjustified": list(self.unjustified),
             "clean": self.clean,
         }

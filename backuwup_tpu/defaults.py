@@ -249,6 +249,37 @@ MATCHMAKING_SHARDS = 8
 # firehose cannot defer the commit (and the durability acks) unboundedly.
 SERVER_STORE_MAX_BATCH = 256
 
+# --- federated coordination plane (net/ring.py, net/server.py /fed/*,
+# docs/server.md §Federation; no reference equivalent) ------------------------
+# Virtual nodes per physical coordination node on the consistent-hash
+# ring.  More vnodes smooth the key distribution (max node share decays
+# ~1/sqrt(vnodes)) at the cost of a larger sorted point list; 64 keeps
+# add/remove key movement within ~2/N in practice.
+FEDERATION_RING_VNODES = 64
+# Store partitions behind PartitionedServerStore when the caller does
+# not pin a count.  Partition count is a *file layout* choice, fixed for
+# the lifetime of the data directory — nodes route to partitions by
+# pubkey, so every node must agree on it.
+SERVER_STORE_PARTITIONS = 4
+# Inter-node RPC (/fed/steal, /fed/notify) total timeout.  Steal RPCs
+# sit on the client's matchmaking request path, so this bounds the tail
+# a dead peer can add to a fulfill.
+FEDERATION_RPC_TIMEOUT_S = 2.0
+# After a failed inter-node RPC the peer is skipped (steal order walks
+# past it, wrong-node redirects are not issued toward it) for this long.
+FEDERATION_PEER_BACKOFF_S = 3.0
+# Client-side: after a refused dial or a failed redirect hop the client
+# pins itself to whatever node answers (sends ``fed_pinned`` so servers
+# skip redirects) for this long, preventing redirect ping-pong while the
+# ring view is stale.
+FEDERATION_CLIENT_PIN_S = 10.0
+# After a remote-steal walk finds every peer empty, the remote leg sits
+# out this long before walking again.  A starved federation otherwise
+# pays a full ring of RPCs on EVERY unfulfilled matchmaking request —
+# an RPC storm that throttles local throughput (~4x on loopback) while
+# producing nothing.
+FEDERATION_STEAL_COOLDOWN_S = 0.05
+
 # --- server-side TTLs (reference server/src/client_auth_manager.rs:17-20) ---
 AUTH_CHALLENGE_TTL_S = 30.0
 SESSION_TTL_S = 24 * 3600.0

@@ -21,7 +21,7 @@ from typing import Callable, Optional
 
 import aiohttp
 
-from .. import wire
+from .. import defaults, wire
 from ..crypto import KeyManager
 from ..obs import trace as obs_trace
 from ..store import Store
@@ -107,16 +107,37 @@ def _ssl_client_context():
 
 
 class ServerClient:
-    """One client's control-plane connection to the coordination server."""
+    """One client's control-plane connection to the coordination server.
+
+    ``addr`` accepts a single ``host:port`` or a LIST of them (a
+    federated deployment, docs/server.md §Federation — order them owner
+    node first).  Failover rules, chosen so a request is never submitted
+    twice:
+
+    * only a DIAL-level failure (``aiohttp.ClientConnectorError`` — the
+      request never reached any server) rotates to the next URL and
+      retries; once any response arrives, the outcome is final for that
+      call (a timeout or dropped response might have been processed);
+    * a 421 :class:`wire.NodeRedirect` is followed at most once per
+      call, and only toward a URL already on the configured list;
+    * after a refused dial or a failed redirect hop the client pins
+      itself (``fed_pinned`` rides in the POST body) for
+      ``FEDERATION_CLIENT_PIN_S`` so servers stop redirecting it while
+      its view of the ring is demonstrably stale — no ping-pong.
+    """
 
     def __init__(self, keys: KeyManager, store: Store,
-                 addr: Optional[str] = None, tls: Optional[bool] = None):
+                 addr=None, tls: Optional[bool] = None):
         self.keys = keys
         self.store = store
-        self.addr = addr or server_addr()
+        if addr is None:
+            addr = server_addr()
+        self.addrs = ([str(a) for a in addr]
+                      if isinstance(addr, (list, tuple)) else [str(addr)])
+        self._addr_i = 0
+        self.failovers = 0  # dial-level URL rotations (test/scorecard hook)
+        self._pinned_until = 0.0
         self.tls = use_tls() if tls is None else tls
-        scheme = "https" if self.tls else "http"
-        self.base = f"{scheme}://{self.addr}"
         self._http: Optional[aiohttp.ClientSession] = None
         self._ws_task: Optional[asyncio.Task] = None
         # push-handler tasks (backup-matched / p2p rendezvous); cancelled
@@ -127,6 +148,38 @@ class ServerClient:
         self.on_finalize_p2p: Optional[Callable] = None
         self.on_audit_due: Optional[Callable] = None
         self.ws_connected = asyncio.Event()
+
+    # --- federated address book --------------------------------------------
+
+    @property
+    def addr(self) -> str:
+        return self.addrs[self._addr_i]
+
+    @property
+    def base(self) -> str:
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{self.addr}"
+
+    def _rotate(self) -> None:
+        """Dial failed: pin + advance to the next configured node."""
+        self.failovers += 1
+        self._pinned_until = (asyncio.get_event_loop().time()
+                              + defaults.FEDERATION_CLIENT_PIN_S)
+        self._addr_i = (self._addr_i + 1) % len(self.addrs)
+
+    def _pinned(self) -> bool:
+        return asyncio.get_event_loop().time() < self._pinned_until
+
+    def _take_redirect(self, url: str) -> bool:
+        """Follow a NodeRedirect only toward a URL already on the
+        configured list (and not the one we are already using)."""
+        scheme = "https" if self.tls else "http"
+        target = url.rstrip("/")
+        for i, a in enumerate(self.addrs):
+            if f"{scheme}://{a}" == target and i != self._addr_i:
+                self._addr_i = i
+                return True
+        return False
 
     async def _session(self) -> aiohttp.ClientSession:
         if self._http is None or self._http.closed:
@@ -165,28 +218,52 @@ class ServerClient:
         with obs_trace.span(f"client{path}"):
             return await self._post_traced(path, msg)
 
+    def _payload(self, msg: wire.JsonMessage) -> str:
+        doc = json.loads(msg.to_json())
+        tid = obs_trace.current_trace_id()
+        if tid:
+            # extra JSON keys: from_json ignores unknown keys, so old
+            # servers interoperate; new ones join the trace (obs/trace.py)
+            doc["trace_id"] = tid
+        if self._pinned():
+            doc["fed_pinned"] = True
+        return json.dumps(doc, separators=(",", ":"), sort_keys=True)
+
     async def _post_traced(self, path: str,
                            msg: wire.JsonMessage) -> wire.JsonMessage:
         http = await self._session()
-        payload = msg.to_json()
-        tid = obs_trace.current_trace_id()
-        if tid:
-            # extra JSON key: from_json ignores unknown keys, so old
-            # servers interoperate; new ones join the trace (obs/trace.py)
-            doc = json.loads(payload)
-            doc["trace_id"] = tid
-            payload = json.dumps(doc, separators=(",", ":"), sort_keys=True)
-        async with http.post(self.base + path, data=payload) as resp:
-            body = await resp.text()
+        # dial failures may try every configured node once; any received
+        # response is final (see the class docstring's no-double-submit
+        # rule).  Single-address clients keep the pre-federation shape:
+        # one attempt, the connect error propagates.
+        dials_left = len(self.addrs) if len(self.addrs) > 1 else 1
+        redirected = False
+        while True:
+            try:
+                async with http.post(self.base + path,
+                                     data=self._payload(msg)) as resp:
+                    body = await resp.text()
+                    status = resp.status
+            except aiohttp.ClientConnectorError:
+                dials_left -= 1
+                if dials_left <= 0:
+                    raise
+                self._rotate()
+                continue
             try:
                 out = wire.JsonMessage.from_json(body)
             except ValueError:
                 out = wire.Error(kind=wire.ErrorKind.FAILURE,
                                  detail=f"unparseable response: {body[:200]}")
-            if resp.status >= 400 or isinstance(out, wire.Error):
+            if status == 421 and isinstance(out, wire.NodeRedirect):
+                if not redirected and self._take_redirect(out.url):
+                    redirected = True
+                    continue
+                raise RetryLater(f"misdirected request: {out.url}")
+            if status >= 400 or isinstance(out, wire.Error):
                 kind = getattr(out, "kind", wire.ErrorKind.FAILURE)
                 detail = getattr(out, "detail", "")
-                if resp.status == 409 and kind == wire.ErrorKind.BAD_REQUEST:
+                if status == 409 and kind == wire.ErrorKind.BAD_REQUEST:
                     raise ClientExists(detail)
                 exc = _KIND_TO_EXC.get(kind, ServerError)
                 raise exc(detail)
@@ -310,6 +387,21 @@ class ServerClient:
                 self.store.set_auth_token(None)
             except asyncio.CancelledError:
                 raise
+            except aiohttp.ClientConnectorError as e:
+                # refused dial: this node is down — rotate to the next
+                # configured node before backing off
+                if len(self.addrs) > 1:
+                    self._rotate()
+                logging.getLogger(__name__).debug(
+                    "server WS dial failed: %s; rotating + reconnecting", e)
+            except aiohttp.WSServerHandshakeError as e:
+                # session tokens are node-local: after a failover the
+                # next node rejects the stale token — drop it so the
+                # retry re-logs-in there
+                if e.status == 401:
+                    self.store.set_auth_token(None)
+                logging.getLogger(__name__).debug(
+                    "server WS handshake failed: %s; reconnecting", e)
             except (aiohttp.ClientError, ServerError, OSError,
                     RuntimeError) as e:
                 # reconnect loop (net_server/mod.rs:26-55): log, back off,

@@ -131,6 +131,15 @@ class ShardedMatchmaker:
         n = defaults.MATCHMAKING_SHARDS if not shards else int(shards)
         self.shards = [_Shard(i) for i in range(max(n, 1))]
         self._seq = itertools.count(1)
+        #: Federation hook (docs/server.md §Federation): an async
+        #: ``(requester, want, share_cap) -> Optional[(candidate, match)]``
+        #: consulted only after every LOCAL shard came up empty — the
+        #: remote continuation of the home-shard-last steal walk.  The
+        #: serving node records the negotiation (both edges) and pushes
+        #: to the candidate before answering, so by the time this
+        #: returns, only the requester-side push remains.  None = no
+        #: federation (single-node deployments) or no remote candidate.
+        self.remote_steal = None
 
     # --- shard routing ------------------------------------------------------
 
@@ -198,7 +207,26 @@ class ShardedMatchmaker:
         while remaining > 0:
             entry = await self._pop_candidate(me)
             if entry is None:
-                break
+                # Every local shard is empty: go remote (federation's
+                # continuation of the home-last walk).  The serving node
+                # has already recorded the negotiation and notified the
+                # candidate, so only the requester-side push remains —
+                # and a failed requester push keeps the records and
+                # stops, exactly the legacy requester-dead semantics.
+                if self.remote_steal is None:
+                    break
+                stolen = await self.remote_steal(me, remaining, share_cap)
+                if stolen is None:
+                    break
+                r_candidate, r_match = stolen
+                ok_self = await self.connections.notify(
+                    me, wire.BackupMatched(destination_id=r_candidate,
+                                           storage_available=r_match))
+                if not ok_self:
+                    self._refresh_depth()
+                    return
+                remaining -= r_match
+                continue
             candidate, cand_remaining, cand_expires = entry
             if await self.db.aio.audit_failing_reporters(
                     candidate, defaults.AUDIT_REPORT_WINDOW_S) \
@@ -256,6 +284,63 @@ class ShardedMatchmaker:
                 shard.add(next(self._seq), me, remaining,
                           time.time() + self.expiry_s)
         self._refresh_depth()
+
+    async def serve_steal(self, requester: bytes, want: int,
+                          share_cap: Optional[int] = None
+                          ) -> Optional[Tuple[bytes, int]]:
+        """Serve one cross-node steal (the /fed/steal RPC body): pop a
+        local candidate for a REMOTE requester, record the negotiation,
+        and push to the (locally connected) candidate.
+
+        This is one iteration of :meth:`fulfill` with the requester-side
+        push left to the requester's own node — the candidate-side
+        invariants are identical: audit-blocked candidates dropped,
+        record-first-then-push, a failed candidate push rolls both edges
+        back and tries the next candidate, remainders re-enqueue, and
+        ``_MATCHMAKINGS`` counts here (the serving side) only, so a
+        pairing is counted exactly once across the federation.
+
+        Returns ``(candidate_pubkey, matched_bytes)`` or None when no
+        eligible local candidate exists.
+        """
+        me = bytes(requester)
+        want = int(want)
+        while True:
+            entry = await self._pop_candidate(me)
+            if entry is None:
+                return None
+            candidate, cand_remaining, cand_expires = entry
+            if await self.db.aio.audit_failing_reporters(
+                    candidate, defaults.AUDIT_REPORT_WINDOW_S) \
+                    >= defaults.AUDIT_SERVER_BLOCK_FAILURES:
+                continue
+            match = min(want, cand_remaining)
+            if share_cap is not None:
+                match = min(match, int(share_cap))
+            # Both edges recorded by the serving node (the store routes
+            # each by pubkey, so placement is identical to a local
+            # fulfill) — keeping record-then-push atomic on one node
+            # instead of splitting the rollback across the RPC.
+            await self.db.aio.save_storage_negotiated(me, candidate, match)
+            await self.db.aio.save_storage_negotiated(candidate, me, match)
+            ok_cand = await self.connections.notify(
+                candidate, wire.BackupMatched(
+                    destination_id=me, storage_available=match))
+            if not ok_cand:
+                await self.db.aio.delete_storage_negotiated(
+                    me, candidate, match)
+                await self.db.aio.delete_storage_negotiated(
+                    candidate, me, match)
+                continue
+            _MATCHMAKINGS.inc()
+            cand_remaining -= match
+            if cand_remaining > 0:
+                shard = self.shard_of(candidate)
+                async with shard.lock:
+                    shard.add(next(self._seq), candidate, cand_remaining,
+                              cand_expires)
+            self._refresh_depth()
+            return candidate, match
 
     # --- introspection ------------------------------------------------------
 

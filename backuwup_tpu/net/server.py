@@ -41,6 +41,7 @@ from typing import Dict, Optional
 
 import json
 
+import aiohttp
 from aiohttp import WSMsgType, web
 
 from .. import defaults, wire
@@ -63,6 +64,32 @@ _REQUEST_SECONDS = obs_metrics.histogram(
     ("route",))
 _CONNECTED = obs_metrics.gauge(
     "bkw_server_connected_clients", "Clients on the WS push channel")
+
+# Federation plane (docs/server.md §Federation).  Steal attempts are
+# counted once per fulfill-side remote leg (hit/miss/error), serves once
+# per /fed/steal RPC answered (hit/empty) — a federated pairing shows up
+# as exactly one serve hit on the serving node and one steal hit on the
+# requesting node.
+_FED_STEALS = obs_metrics.counter(
+    "bkw_federation_steals_total",
+    "Requester-side cross-node steal attempts by outcome"
+    " (hit/miss/error)", ("outcome",))
+_FED_STEAL_SERVED = obs_metrics.counter(
+    "bkw_federation_steal_served_total",
+    "Serving-side /fed/steal RPCs answered by outcome (hit/empty)",
+    ("outcome",))
+_FED_RPC_SECONDS = obs_metrics.histogram(
+    "bkw_federation_rpc_seconds",
+    "Inter-node federation RPC latency by op", ("op",))
+_FED_NOTIFY_RELAYS = obs_metrics.counter(
+    "bkw_federation_notify_relays_total",
+    "WS pushes relayed to another node's client by outcome"
+    " (delivered/failed)", ("outcome",))
+_RING_NODES = obs_metrics.gauge(
+    "bkw_ring_nodes", "Coordination nodes on this node's hash ring")
+_RING_REDIRECTS = obs_metrics.counter(
+    "bkw_ring_redirects_total",
+    "Wrong-node arrivals answered with a NodeRedirect (HTTP 421)")
 
 # Families the clients of this process produce into; declared here too
 # (get-or-create merges them) so a standalone server's /metrics always
@@ -113,10 +140,20 @@ class AuthManager:
 
 
 class Connections:
-    """client-id -> WS push sink registry (server/src/ws.rs:73-109)."""
+    """client-id -> WS push sink registry (server/src/ws.rs:73-109).
+
+    With federation enabled, ``relay`` is an async
+    ``(client_id, msg) -> bool`` hook consulted when the client has no
+    LOCAL socket: the push is forwarded to the node that does hold it
+    (/fed/notify), so p2p rendezvous, AuditDue nudges, and steal-served
+    matches reach clients wherever they (re)connected.  ``is_online``
+    stays local on purpose — it gates queue admission, and a remote
+    socket's liveness is the remote node's business.
+    """
 
     def __init__(self):
         self._socks: Dict[bytes, web.WebSocketResponse] = {}
+        self.relay = None
 
     def register(self, client_id: bytes, ws: web.WebSocketResponse) -> None:
         self._socks[bytes(client_id)] = ws
@@ -133,7 +170,10 @@ class Connections:
     def is_online(self, client_id: bytes) -> bool:
         return bytes(client_id) in self._socks
 
-    async def notify(self, client_id: bytes, msg: wire.JsonMessage) -> bool:
+    async def notify_local(self, client_id: bytes,
+                           msg: wire.JsonMessage) -> bool:
+        """Push to a locally connected socket only (the /fed/notify
+        handler terminates here — a relay must never re-relay)."""
         ws = self._socks.get(bytes(client_id))
         if ws is None or ws.closed:
             return False
@@ -143,6 +183,13 @@ class Connections:
         except (ConnectionError, RuntimeError):
             self._socks.pop(bytes(client_id), None)
             return False
+
+    async def notify(self, client_id: bytes, msg: wire.JsonMessage) -> bool:
+        if await self.notify_local(client_id, msg):
+            return True
+        if self.relay is not None:
+            return await self.relay(bytes(client_id), msg)
+        return False
 
 
 class StorageQueue:
@@ -324,6 +371,11 @@ class CoordinationServer:
 
     def __init__(self, db_path=":memory:", store: Optional[ServerStore] = None,
                  legacy: bool = False, shards: Optional[int] = None):
+        # An injected store has a wider lifecycle than this server: a
+        # federated deployment shares one PartitionedServerStore across
+        # node instances (and node revive reuses it), so stop() only
+        # closes stores this instance constructed.
+        self._owns_store = store is None
         if store is None:
             store = (ServerDB(db_path) if legacy
                      else SqliteServerStore(db_path))
@@ -339,6 +391,13 @@ class CoordinationServer:
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
         self._started = time.time()
+        # federation state (dormant until enable_federation)
+        self.node_id: Optional[str] = None
+        self.ring = None
+        self.peers: Dict[str, str] = {}
+        self._fed_http: Optional[aiohttp.ClientSession] = None
+        self._peer_down_until: Dict[str, float] = {}
+        self._steal_cooldown_until = 0.0
 
     # --- helpers -----------------------------------------------------------
 
@@ -378,10 +437,213 @@ class CoordinationServer:
         return web.Response(text=(msg or wire.Ok()).to_json(),
                             content_type="application/json")
 
+    # --- federation (docs/server.md §Federation) ----------------------------
+
+    def enable_federation(self, node_id: str, ring, peers: Dict[str, str]
+                          ) -> None:
+        """Join this node to a federated deployment.
+
+        ``ring`` is the shared :class:`~.ring.HashRing` (every node and
+        client computes the identical ring from the node list);
+        ``peers`` maps node id -> base URL for every node, this one
+        included.  Call after :meth:`start` (peer URLs carry the
+        OS-assigned ports).  Wires up:
+
+        * the matchmaker's ``remote_steal`` leg — consulted only once
+          every local shard is empty, walking ``ring.steal_order`` with
+          per-peer dial backoff;
+        * WS push relay — pushes for clients connected elsewhere are
+          forwarded over /fed/notify, owner node first;
+        * wrong-node redirects — session-less entry points answer 421
+          with the owner's URL when the arrival is misrouted.
+
+        Trust model: /fed/* is unauthenticated — federation assumes a
+        private inter-node network, same trust boundary as the shared
+        store files.
+        """
+        self.node_id = str(node_id)
+        self.ring = ring
+        self.peers = {str(n): u.rstrip("/") for n, u in peers.items()
+                      if str(n) != self.node_id}
+        if isinstance(self.queue, ShardedMatchmaker):
+            self.queue.remote_steal = self._remote_steal
+        self.connections.relay = self._relay_notify
+        _RING_NODES.set(len(ring))
+
+    def _fed_session(self) -> aiohttp.ClientSession:
+        if self._fed_http is None or self._fed_http.closed:
+            self._fed_http = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(
+                    total=defaults.FEDERATION_RPC_TIMEOUT_S))
+        return self._fed_http
+
+    def _peer_down(self, node_id: str) -> bool:
+        return self._peer_down_until.get(node_id, 0.0) > time.monotonic()
+
+    def _mark_peer_down(self, node_id: str) -> None:
+        self._peer_down_until[node_id] = (
+            time.monotonic() + defaults.FEDERATION_PEER_BACKOFF_S)
+
+    async def _fed_post(self, node_id: str, path: str, body: dict,
+                        op: str) -> Optional[dict]:
+        """One inter-node RPC: POST ``body`` (plus the current trace id,
+        which the peer's _obs_middleware adopts — cross-node spans
+        journal under the caller's id) to ``node_id``.  Failures mark
+        the peer down for FEDERATION_PEER_BACKOFF_S and return None."""
+        url = self.peers.get(node_id)
+        if url is None or self._peer_down(node_id):
+            return None
+        body = dict(body, trace_id=obs_trace.current_trace_id())
+        t0 = time.monotonic()
+        try:
+            async with self._fed_session().post(url + path,
+                                                json=body) as resp:
+                doc = await resp.json()
+            if resp.status != 200:
+                return None
+            self._peer_down_until.pop(node_id, None)
+            return doc
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            self._mark_peer_down(node_id)
+            return None
+        finally:
+            _FED_RPC_SECONDS.observe(time.monotonic() - t0, op=op)
+
+    async def _remote_steal(self, requester: bytes, want: int,
+                            share_cap: Optional[int]):
+        """The matchmaker's remote leg: walk the other nodes in
+        ring-successor order (the federated continuation of the
+        home-shard-last walk) and take the first served candidate.
+
+        A full walk that comes back empty means the WHOLE federation is
+        starved; retrying the ring on every subsequent fulfill would
+        turn global starvation into an RPC storm that throttles local
+        throughput (measured: ~4x on loopback).  An empty walk therefore
+        arms a short negative cache and the remote leg sits out until it
+        expires or a steal hits."""
+        if self._steal_cooldown_until > time.monotonic():
+            return None
+        # arm BEFORE walking: concurrent fulfills that arrive while this
+        # walk's RPCs are in flight skip instead of piling on; a hit
+        # clears it again below
+        self._steal_cooldown_until = (
+            time.monotonic() + defaults.FEDERATION_STEAL_COOLDOWN_S)
+        tried = 0
+        for node in self.ring.steal_order(self.node_id):
+            if node not in self.peers or self._peer_down(node):
+                continue
+            tried += 1
+            doc = await self._fed_post(node, "/fed/steal", {
+                "requester": bytes(requester).hex(),
+                "want": int(want),
+                "share_cap": share_cap,
+            }, op="steal")
+            if doc is None:
+                _FED_STEALS.inc(outcome="error")
+                continue
+            if doc.get("candidate"):
+                _FED_STEALS.inc(outcome="hit")
+                self._steal_cooldown_until = 0.0
+                return bytes.fromhex(doc["candidate"]), int(doc["match"])
+        if tried:
+            _FED_STEALS.inc(outcome="miss")
+        return None
+
+    async def _relay_notify(self, client_id: bytes,
+                            msg: wire.JsonMessage) -> bool:
+        """Forward a WS push to whichever node holds the client's
+        socket: the ring owner first (where the client *should* be),
+        then the rest — a failed-over client may be anywhere."""
+        if self.ring is None:
+            return False
+        owner = self.ring.owner(client_id)
+        order = [n for n in ([owner] + self.ring.steal_order(self.node_id))
+                 if n is not None and n != self.node_id]
+        seen = set()
+        for node in order:
+            if node in seen:
+                continue
+            seen.add(node)
+            doc = await self._fed_post(node, "/fed/notify", {
+                "client": bytes(client_id).hex(),
+                "msg": msg.to_json(),
+            }, op="notify")
+            if doc is not None and doc.get("delivered"):
+                _FED_NOTIFY_RELAYS.inc(outcome="delivered")
+                return True
+        _FED_NOTIFY_RELAYS.inc(outcome="failed")
+        return False
+
+    async def fed_steal(self, request):
+        """Inter-node RPC: serve one matchmaking candidate to a remote
+        requester (see ShardedMatchmaker.serve_steal for the
+        invariants)."""
+        if self.node_id is None or not isinstance(self.queue,
+                                                  ShardedMatchmaker):
+            raise self._err(wire.ErrorKind.BAD_REQUEST,
+                            "federation not enabled")
+        try:
+            doc = json.loads(await request.text())
+            requester = bytes.fromhex(doc["requester"])
+            want = int(doc["want"])
+            cap = doc.get("share_cap")
+        except (ValueError, KeyError, TypeError) as e:
+            raise self._err(wire.ErrorKind.BAD_REQUEST, str(e))
+        served = await self.queue.serve_steal(
+            requester, want, None if cap is None else int(cap))
+        if served is None:
+            _FED_STEAL_SERVED.inc(outcome="empty")
+            return web.json_response({"candidate": None})
+        _FED_STEAL_SERVED.inc(outcome="hit")
+        return web.json_response({"candidate": served[0].hex(),
+                                  "match": served[1]})
+
+    async def fed_notify(self, request):
+        """Inter-node RPC: deliver a WS push to a LOCALLY connected
+        client (terminates here — never re-relays)."""
+        if self.node_id is None:
+            raise self._err(wire.ErrorKind.BAD_REQUEST,
+                            "federation not enabled")
+        try:
+            doc = json.loads(await request.text())
+            client = bytes.fromhex(doc["client"])
+            msg = wire.JsonMessage.from_json(doc["msg"])
+        except (ValueError, KeyError, TypeError) as e:
+            raise self._err(wire.ErrorKind.BAD_REQUEST, str(e))
+        delivered = await self.connections.notify_local(client, msg)
+        return web.json_response({"delivered": delivered})
+
+    def _maybe_redirect(self, pubkey: bytes, raw_body: str) -> None:
+        """Wrong-node arrival on a session-less entry point: steer the
+        client to its ring owner with a 421 NodeRedirect — unless the
+        client pinned itself (``fed_pinned``, set after a failed dial or
+        redirect hop: whatever node answers then keeps it) or the owner
+        looks down.  Requests served in place remain CORRECT either way
+        — the store routes by pubkey, not by serving node — so a stale
+        client list costs latency, never a matchmaking."""
+        if self.ring is None:
+            return
+        owner = self.ring.owner(pubkey)
+        if owner is None or owner == self.node_id:
+            return
+        url = self.peers.get(owner)
+        if url is None or self._peer_down(owner):
+            return
+        try:
+            if json.loads(raw_body).get("fed_pinned"):
+                return
+        except (ValueError, AttributeError):
+            pass
+        _RING_REDIRECTS.inc()
+        raise web.HTTPMisdirectedRequest(
+            text=wire.NodeRedirect(url=url).to_json(),
+            content_type="application/json")
+
     # --- handlers (server/src/handlers/) -----------------------------------
 
     async def register_begin(self, request):
         msg = await self._parse(request, wire.ClientRegistrationRequest)
+        self._maybe_redirect(msg.pubkey, await request.text())
         return self._ok(wire.ServerChallenge(
             nonce=self.auth.challenge_begin(msg.pubkey)))
 
@@ -404,6 +666,7 @@ class CoordinationServer:
 
     async def login_begin(self, request):
         msg = await self._parse(request, wire.ClientLoginRequest)
+        self._maybe_redirect(msg.pubkey, await request.text())
         if not await self.db.aio.client_exists(msg.pubkey):
             raise self._err(wire.ErrorKind.CLIENT_NOT_FOUND)
         return self._ok(wire.ServerChallenge(
@@ -569,6 +832,8 @@ class CoordinationServer:
             web.post("/p2p/connection/confirm", self.p2p_confirm),
             web.post("/audit/report", self.audit_report),
             web.post("/repair/report", self.repair_report),
+            web.post("/fed/steal", self.fed_steal),
+            web.post("/fed/notify", self.fed_notify),
             web.get("/ws", self.ws),
         ])
         return app
@@ -586,9 +851,16 @@ class CoordinationServer:
         return self.port
 
     async def stop(self) -> None:
+        if self._fed_http is not None:
+            if not self._fed_http.closed:
+                await self._fed_http.close()
+            self._fed_http = None
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
         # drain + retire the writer thread; the store stays readable
-        # (tests inspect server.db after stop)
-        self.db.close()
+        # (tests inspect server.db after stop).  An injected store is
+        # the caller's (a federated deployment shares it across node
+        # instances — node kill/revive must not close siblings' store).
+        if self._owns_store:
+            self.db.close()

@@ -43,16 +43,19 @@ bench's baseline-vs-sharded comparison is durability-for-durability.
 from __future__ import annotations
 
 import abc
+import asyncio
 import queue
 import sqlite3
 import threading
 import time
 from concurrent.futures import Future
+from pathlib import Path
 from typing import Dict, List, Optional
 
 from .. import defaults
 from ..obs import metrics as obs_metrics
 from ..utils import durable
+from .ring import partition_of as ring_partition_of
 
 _COMMITS = obs_metrics.counter(
     "bkw_server_store_commits_total",
@@ -244,6 +247,11 @@ class SqliteServerStore(ServerStore):
         self._db = sqlite3.connect(path, check_same_thread=False)
         if path != ":memory:":
             self._db.execute("PRAGMA journal_mode=WAL")
+            # Federation opens the same partition files from several
+            # store instances (node revive, multi-process bench legs):
+            # wait out a sibling's group commit instead of raising
+            # "database is locked" into a request handler.
+            self._db.execute("PRAGMA busy_timeout=5000")
             # fsync-disciplined group commit (utils/durable.py semantics):
             # FULL makes each COMMIT a durability barrier; with fsync
             # globally off (BKW_FSYNC=0 test runs) NORMAL suffices.
@@ -586,3 +594,191 @@ class ServerDB(SqliteServerStore):
 
     def __init__(self, path):
         super().__init__(path, write_behind=False)
+
+
+class _PartitionedAio:
+    """``store.aio.<method>`` for :class:`PartitionedServerStore`:
+    routed ops delegate to the owning partition's own aio facade;
+    fan-out ops gather across every partition and merge."""
+
+    def __init__(self, store: "PartitionedServerStore"):
+        self._store = store
+
+    def __getattr__(self, name: str):
+        if getattr(type(self._store.parts[0]), "_op_" + name, None) is None:
+            raise AttributeError(name)
+        store = self._store
+
+        async def call(*args):
+            return await store._dispatch_async(name, args)
+
+        call.__name__ = name
+        return call
+
+
+class PartitionedServerStore(ServerStore):
+    """N per-partition sqlite stores behind the one ServerStore ABC.
+
+    The federation deployment unit (docs/server.md §Federation): every
+    coordination node opens the SAME partition directory and routes each
+    call by its leading pubkey (``ring.partition_of`` — the convention
+    the ABC docstring promises), so store correctness never depends on
+    WHICH node served a request.  A wrong-node arrival is merely slower
+    (cross-partition WAL contention), never wrong — and node kill/revive
+    cannot lose state because the partition files outlive any one
+    server instance.
+
+    Cross-partition reads fan out and merge:
+
+    * ``get_clients_storing_on`` — reverse edges live under each
+      source's partition: union (first-seen order) across partitions.
+    * ``audit_failing_reporters`` — all of one reporter's reports land
+      in the reporter's partition, so each partition's latest-per-
+      reporter verdict is already globally latest: sum the counts.
+    * ``reclaim_negotiation`` — the two edge directions live under the
+      two endpoints' partitions: run on both (once if they collide) and
+      sum removed rows.
+
+    Everything else routes to exactly one partition, preserving the
+    single-writer group-commit durability barrier per partition.
+    """
+
+    _FAN_OUT = frozenset({"get_clients_storing_on",
+                          "audit_failing_reporters"})
+
+    def __init__(self, root, partitions: Optional[int] = None,
+                 write_behind: bool = True):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        n = max(1, int(partitions or defaults.SERVER_STORE_PARTITIONS))
+        self.write_behind = bool(write_behind)
+        self.parts: List[SqliteServerStore] = [
+            SqliteServerStore(str(self.root / f"part_{i:02d}.db"),
+                              write_behind=write_behind)
+            for i in range(n)]
+
+    def partition_for(self, pubkey: bytes) -> SqliteServerStore:
+        return self.parts[ring_partition_of(pubkey, len(self.parts))]
+
+    @property
+    def commit_threads(self) -> set:
+        out: set = set()
+        for p in self.parts:
+            out |= p.commit_threads
+        return out
+
+    # --- dispatch ----------------------------------------------------------
+
+    def _reclaim_targets(self, client: bytes,
+                         peer: bytes) -> List[SqliteServerStore]:
+        a, b = self.partition_for(client), self.partition_for(peer)
+        return [a] if a is b else [a, b]
+
+    @staticmethod
+    def _merge_distinct(results: List[list]) -> list:
+        seen, out = set(), []
+        for part in results:
+            for pk in part:
+                if pk not in seen:
+                    seen.add(pk)
+                    out.append(pk)
+        return out
+
+    def _dispatch_sync(self, name: str, args):
+        if name == "schema_version":
+            return self.parts[0].schema_version()
+        if name in self._FAN_OUT:
+            results = [getattr(p, name)(*args) for p in self.parts]
+            if name == "audit_failing_reporters":
+                return sum(results)
+            return self._merge_distinct(results)
+        if name == "reclaim_negotiation":
+            return sum(p.reclaim_negotiation(*args)
+                       for p in self._reclaim_targets(*args))
+        return getattr(self.partition_for(args[0]), name)(*args)
+
+    async def _dispatch_async(self, name: str, args):
+        if name == "schema_version":
+            return await self.parts[0].aio.schema_version()
+        if name in self._FAN_OUT:
+            results = await asyncio.gather(
+                *(getattr(p.aio, name)(*args) for p in self.parts))
+            if name == "audit_failing_reporters":
+                return sum(results)
+            return self._merge_distinct(list(results))
+        if name == "reclaim_negotiation":
+            counts = await asyncio.gather(
+                *(p.aio.reclaim_negotiation(*args)
+                  for p in self._reclaim_targets(*args)))
+            return sum(counts)
+        part = self.partition_for(args[0])
+        return await getattr(part.aio, name)(*args)
+
+    @property
+    def aio(self) -> _PartitionedAio:
+        return _PartitionedAio(self)
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def flush(self) -> None:
+        for p in self.parts:
+            p.flush()
+
+    def close(self) -> None:
+        for p in self.parts:
+            p.close()
+
+    # --- the ServerStore surface, routed ------------------------------------
+
+    def schema_version(self) -> int:
+        return self._dispatch_sync("schema_version", ())
+
+    def register_client(self, pubkey: bytes) -> None:
+        self._dispatch_sync("register_client", (pubkey,))
+
+    def client_exists(self, pubkey: bytes) -> bool:
+        return self._dispatch_sync("client_exists", (pubkey,))
+
+    def client_update_logged_in(self, pubkey: bytes) -> None:
+        self._dispatch_sync("client_update_logged_in", (pubkey,))
+
+    def save_storage_negotiated(self, source: bytes, destination: bytes,
+                                size: int) -> None:
+        self._dispatch_sync("save_storage_negotiated",
+                            (source, destination, size))
+
+    def delete_storage_negotiated(self, source: bytes, destination: bytes,
+                                  size: int) -> None:
+        self._dispatch_sync("delete_storage_negotiated",
+                            (source, destination, size))
+
+    def save_snapshot(self, pubkey: bytes, snapshot_hash: bytes) -> None:
+        self._dispatch_sync("save_snapshot", (pubkey, snapshot_hash))
+
+    def get_latest_client_snapshot(self, pubkey: bytes) -> Optional[bytes]:
+        return self._dispatch_sync("get_latest_client_snapshot", (pubkey,))
+
+    def get_client_negotiated_peers(self, pubkey: bytes) -> list:
+        return self._dispatch_sync("get_client_negotiated_peers", (pubkey,))
+
+    def get_clients_storing_on(self, pubkey: bytes) -> list:
+        return self._dispatch_sync("get_clients_storing_on", (pubkey,))
+
+    def save_audit_report(self, reporter: bytes, peer: bytes, passed: bool,
+                          detail: str) -> None:
+        self._dispatch_sync("save_audit_report",
+                            (reporter, peer, passed, detail))
+
+    def save_repair_report(self, reporter: bytes, peer: bytes,
+                           packfiles_lost: int, bytes_lost: int,
+                           bytes_replaced: int) -> None:
+        self._dispatch_sync("save_repair_report",
+                            (reporter, peer, packfiles_lost, bytes_lost,
+                             bytes_replaced))
+
+    def reclaim_negotiation(self, client: bytes, peer: bytes) -> int:
+        return self._dispatch_sync("reclaim_negotiation", (client, peer))
+
+    def audit_failing_reporters(self, peer: bytes, window_s: float) -> int:
+        return self._dispatch_sync("audit_failing_reporters",
+                                   (peer, window_s))

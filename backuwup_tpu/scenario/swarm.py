@@ -58,11 +58,15 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
+import aiohttp
+
 from .. import defaults
 from ..crypto import KeyManager
 from ..net import client as net_client
 from ..net.matchmaking import _MATCHMAKINGS, ShardedMatchmaker
+from ..net.ring import HashRing
 from ..net.server import _REQUEST_SECONDS, CoordinationServer
+from ..net.serverstore import PartitionedServerStore
 from ..obs import metrics as obs_metrics
 from .harness import Phase, ScenarioHarness
 from . import scorecard as sc
@@ -110,6 +114,16 @@ class SwarmSpec:
     #: load-generator threads the clients are distributed over (keeps
     #: the drivers off the server's event loop — see module docstring)
     workers: int = 8
+    #: coordination nodes; >1 deploys the federation: one shared
+    #: :class:`~..net.serverstore.PartitionedServerStore`, a consistent-hash
+    #: ring, and N servers with work stealing + notify relay enabled
+    #: (implies the sharded tier — ``legacy`` is ignored)
+    nodes: int = 1
+    #: store partitions when ``nodes > 1`` (defaults to ``nodes``)
+    partitions: Optional[int] = None
+    #: hard per-route p99 ceiling for the federation gate (only asserted
+    #: when ``nodes > 1``; generous — loopback plus failover dial cost)
+    p99_budget_s: float = 2.5
 
 
 class _TokenStore:
@@ -129,12 +143,20 @@ class SwarmClient:
     """One simulated identity: deterministic keys, its own HTTP session
     and WS push channel, and a count of matches pushed to it."""
 
-    def __init__(self, index: int, seed: int, addr: str):
+    def __init__(self, index: int, seed: int, addr,
+                 ring: Optional[HashRing] = None,
+                 node_addrs: Optional[Dict[str, str]] = None):
         self.index = index
         self.worker = None  # set by the harness when homed on a worker
         secret = (seed.to_bytes(8, "big", signed=False)
                   + index.to_bytes(8, "big")).ljust(32, b"\x77")
         self.keys = KeyManager.from_secret(secret)
+        if ring is not None:
+            # federation: dial the ring owner first, then its steal order
+            # — the shape a published node list would hand a real client
+            owner = ring.owner(bytes(self.keys.client_id))
+            order = [owner] + ring.steal_order(owner)
+            addr = [node_addrs[n] for n in order]
         self.client = net_client.ServerClient(
             self.keys, _TokenStore(), addr=addr, tls=False)
         self.matches = 0
@@ -258,7 +280,15 @@ class SwarmHarness(ScenarioHarness):
                       "churns": 0, "swarm_matchmakings": 0,
                       "swarm_elapsed_s": 0.0, "matchmakings_per_s": 0.0,
                       "client_matches": 0, "max_stall_s": None,
-                      "commits_on_loop": None, "p99_request_s": None}
+                      "commits_on_loop": None, "p99_request_s": None,
+                      "node_kills": 0, "failovers": 0,
+                      "post_revive_matchmakings": None,
+                      "total_matchmakings": 0, "negotiated_rows": None}
+        self.servers: List[CoordinationServer] = []
+        self.ring: Optional[HashRing] = None
+        self.node_ids: List[str] = []
+        self.peer_urls: Dict[str, str] = {}
+        self.store = None
 
     # --- lifecycle ---------------------------------------------------------
 
@@ -267,11 +297,38 @@ class SwarmHarness(ScenarioHarness):
         self._saved = {"BACKUP_REQUEST_EXPIRY_S":
                        defaults.BACKUP_REQUEST_EXPIRY_S}
         defaults.BACKUP_REQUEST_EXPIRY_S = spec.expiry_s
-        self.server = CoordinationServer(
-            db_path=str(self.workdir / "server.db"),
-            legacy=spec.legacy, shards=spec.shards)
-        self.server_port = await self.server.start()
+        if spec.nodes > 1:
+            # federation deployment: every node fronts the SAME
+            # partitioned store (the in-process analogue of nodes
+            # sharing replicated partitions), so killing a node loses
+            # connections and in-flight handlers but never rows
+            self.store = await asyncio.to_thread(
+                PartitionedServerStore, str(self.workdir / "store"),
+                spec.partitions or spec.nodes)
+            self.node_ids = [f"node{i}" for i in range(spec.nodes)]
+            self.ring = HashRing(self.node_ids)
+            for _nid in self.node_ids:
+                srv = CoordinationServer(store=self.store,
+                                         shards=spec.shards)
+                await srv.start()
+                self.servers.append(srv)
+            self.peer_urls = {
+                nid: f"http://127.0.0.1:{srv.port}"
+                for nid, srv in zip(self.node_ids, self.servers)}
+            for nid, srv in zip(self.node_ids, self.servers):
+                srv.enable_federation(nid, self.ring, self.peer_urls)
+            self.server = self.servers[0]
+            self.server_port = self.server.port
+        else:
+            self.server = CoordinationServer(
+                db_path=str(self.workdir / "server.db"),
+                legacy=spec.legacy, shards=spec.shards)
+            self.server_port = await self.server.start()
+            self.servers = [self.server]
+            self.store = self.server.db
         addr = f"127.0.0.1:{self.server_port}"
+        node_addrs = {nid: url.removeprefix("http://")
+                      for nid, url in self.peer_urls.items()}
         self.workers = [_Worker(i)
                         for i in range(max(1, min(spec.workers,
                                                   spec.clients)))]
@@ -280,7 +337,8 @@ class SwarmHarness(ScenarioHarness):
             # created ON the worker loop so every asyncio primitive the
             # client owns (events, sessions, ws tasks) binds there
             for i in indices:
-                c = SwarmClient(i, spec.seed, addr)
+                c = SwarmClient(i, spec.seed, addr,
+                                ring=self.ring, node_addrs=node_addrs)
                 c.worker = worker
                 worker.clients.append(c)
 
@@ -292,26 +350,34 @@ class SwarmHarness(ScenarioHarness):
             (c for w in self.workers for c in w.clients),
             key=lambda c: c.index)
         if spec.audit_history:
-            self._preload_audit_history()
+            await asyncio.to_thread(self._preload_audit_history)
+        self._mm0 = _MATCHMAKINGS.value()
         self.stalls.start()
 
     def _preload_audit_history(self) -> None:
         """Bulk-insert passing verdicts (setup-time, pre-measurement) so
-        every client enters matchmaking with a populated audit window."""
-        conn = self.server.db._db
+        every client enters matchmaking with a populated audit window.
+        Rows route by REPORTER partition when the store is partitioned —
+        the same invariant the write path keeps, so the fan-out read
+        sees every reporter's latest verdicts."""
         now = time.time()
-        rows = []
+        groups: Dict[int, Tuple] = {}
         for c in self.clients:
             reporter = self.clients[(c.index + 1) % len(self.clients)]
+            store = (self.store.partition_for(reporter.client_id)
+                     if isinstance(self.store, PartitionedServerStore)
+                     else self.store)
+            _, rows = groups.setdefault(id(store), (store, []))
             rows.extend(
                 (reporter.client_id, c.client_id, 1, "preload",
                  now - i * 1e-3)
                 for i in range(self.spec.audit_history))
-        with getattr(self.server.db, "_direct_lock"):
-            conn.executemany(
-                "INSERT INTO audit_reports (reporter, peer, passed, detail,"
-                " timestamp) VALUES (?, ?, ?, ?, ?)", rows)
-            conn.commit()
+        for store, rows in groups.values():
+            with getattr(store, "_direct_lock"):
+                store._db.executemany(
+                    "INSERT INTO audit_reports (reporter, peer, passed,"
+                    " detail, timestamp) VALUES (?, ?, ?, ?, ?)", rows)
+                store._db.commit()
 
     async def teardown(self) -> None:
         await self.stalls.stop()
@@ -324,8 +390,12 @@ class SwarmHarness(ScenarioHarness):
             with contextlib.suppress(Exception):
                 await asyncio.wait_for(w.submit(close_all(w)), 30)
             w.stop()
-        if self.server is not None:
-            await self.server.stop()
+        for srv in (self.servers or
+                    ([self.server] if self.server is not None else [])):
+            await srv.stop()
+        if self.spec.nodes > 1 and self.store is not None:
+            # injected store: the servers don't own it, close it here
+            await asyncio.to_thread(self.store.close)
         for k, v in self._saved.items():
             setattr(defaults, k, v)
 
@@ -404,18 +474,23 @@ class SwarmHarness(ScenarioHarness):
                 elif churner:
                     await c.rejoin_ws()
                     counts["churns"] += 1
-            except net_client.ServerError:
+            except (net_client.ServerError, aiohttp.ClientError,
+                    asyncio.TimeoutError, OSError):
+                # server rejections, plus the connection errors a node
+                # kill inflicts on requests already in flight (dial
+                # failures against live fallbacks are absorbed by the
+                # client's failover and never surface here)
                 counts["errors"] += 1
             # always yield: a zero-think no-op roll must not spin the
             # worker loop and starve its sibling clients
             await asyncio.sleep(rng.uniform(0.0, spec.think_s)
                                 if spec.think_s > 0 else 0)
 
-    async def _phase_swarm(self, ph: Phase) -> None:
-        duration = ph.duration_s or self.spec.duration_s
-        t0 = time.monotonic()
-        mm0 = _MATCHMAKINGS.value()
-        deadline = t0 + duration
+    async def _drive_window(self, duration: float) -> None:
+        """Run every client's request loop across all workers for
+        ``duration`` seconds, folding the per-worker counters into the
+        facts afterwards."""
+        deadline = time.monotonic() + duration
 
         async def drive_all(worker: _Worker) -> None:
             await asyncio.gather(*(self._drive(c, deadline, worker.counts)
@@ -427,24 +502,76 @@ class SwarmHarness(ScenarioHarness):
         finally:
             for key in ("requests", "errors", "churns"):
                 self.facts[key] = sum(w.counts[key] for w in self.workers)
+
+    async def _phase_swarm(self, ph: Phase) -> None:
+        duration = ph.duration_s or self.spec.duration_s
+        t0 = time.monotonic()
+        mm0 = _MATCHMAKINGS.value()
+        await self._drive_window(duration)
         elapsed = time.monotonic() - t0
         made = _MATCHMAKINGS.value() - mm0
         self.facts["swarm_elapsed_s"] = round(elapsed, 3)
         self.facts["swarm_matchmakings"] = int(made)
         self.facts["matchmakings_per_s"] = round(made / elapsed, 2)
 
+    async def _phase_nodekill(self, ph: Phase) -> None:
+        """Federation churn: stop a non-primary node mid-run (its homed
+        clients fail over along their ring order), keep driving, revive
+        a fresh server over the SAME shared store on the SAME port,
+        re-enable federation, and drive again.  The gates downstream
+        assert no matchmaking's durable rows were lost across the kill
+        and that matches flow again after the revive."""
+        spec = self.spec
+        if len(self.servers) < 2:
+            raise RuntimeError("nodekill phase requires nodes > 1")
+        window = (ph.duration_s or 1.6) / 2
+        victim_i = 1
+        nid = self.node_ids[victim_i]
+        port = self.servers[victim_i].port
+        await self.servers[victim_i].stop()
+        self.facts["node_kills"] += 1
+        await self._drive_window(window)
+        revived = CoordinationServer(store=self.store, shards=spec.shards)
+        await revived.start(port=port)
+        revived.enable_federation(nid, self.ring, self.peer_urls)
+        self.servers[victim_i] = revived
+        mm0 = _MATCHMAKINGS.value()
+        await self._drive_window(window)
+        self.facts["post_revive_matchmakings"] = int(
+            _MATCHMAKINGS.value() - mm0)
+
     async def _phase_drain(self, ph: Phase) -> None:
         """Let in-flight fulfills settle, force the write-behind queue
         through a commit (off-loop), and capture the verdict facts."""
         await asyncio.sleep(ph.duration_s or 0.2)
-        await asyncio.to_thread(self.server.db.flush)
+        await asyncio.to_thread(self.store.flush)
         self.facts["client_matches"] = sum(c.matches for c in self.clients)
         self.facts["max_stall_s"] = round(self.stalls.max_stall_s, 4)
         self.facts["commits_on_loop"] = (
-            threading.get_ident() in self.server.db.commit_threads)
+            threading.get_ident() in self.store.commit_threads)
         p99 = _REQUEST_SECONDS.quantile(0.99, route="/backups/request")
         self.facts["p99_request_s"] = (
             None if math.isnan(p99) else round(p99, 5))
+        self.facts["total_matchmakings"] = int(
+            _MATCHMAKINGS.value() - self._mm0)
+        self.facts["failovers"] = sum(
+            c.client.failovers for c in self.clients)
+        if self.spec.nodes > 1:
+            self.facts["negotiated_rows"] = await asyncio.to_thread(
+                self._count_negotiated_rows)
+
+    def _count_negotiated_rows(self) -> int:
+        """Durable matchmaking evidence across every partition: each
+        completed matchmaking writes one row per negotiation endpoint,
+        so ``rows >= 2 * matchmakings`` iff no completed matchmaking
+        lost its records (kill-window orphans can only ADD rows)."""
+        total = 0
+        parts = getattr(self.store, "parts", [self.store])
+        for p in parts:
+            with getattr(p, "_direct_lock"):
+                total += p._db.execute(
+                    "SELECT COUNT(*) FROM peer_backups").fetchone()[0]
+        return total
 
     # --- gates -------------------------------------------------------------
 
@@ -482,6 +609,30 @@ class SwarmHarness(ScenarioHarness):
             reaps = self.server.queue.reap_ops()
             out.append(A("deadline_heap_live", reaps >= 0,
                          f"reap_ops={reaps}"))
+        if spec.nodes > 1:
+            # federation gates: clients actually exercised failover,
+            # every completed matchmaking kept both durable rows across
+            # the kill/revive, matches flowed again after the revive,
+            # and the per-route p99 stayed bounded through the churn
+            out.append(A("federation_failover_exercised",
+                         facts["node_kills"] == 0
+                         or facts["failovers"] >= 1,
+                         f"failovers={facts['failovers']}"))
+            rows, mm = facts["negotiated_rows"], facts["total_matchmakings"]
+            out.append(A("federation_no_lost_matchmakings",
+                         rows is not None and rows >= 2 * mm,
+                         f"negotiated_rows={rows}"
+                         f" matchmakings={mm} (need >= {2 * mm})"))
+            out.append(A("federation_post_revive_flow",
+                         facts["node_kills"] == 0
+                         or (facts["post_revive_matchmakings"] or 0) > 0,
+                         "post_revive_matchmakings="
+                         f"{facts['post_revive_matchmakings']}"))
+            out.append(A("federation_p99_bounded",
+                         facts["p99_request_s"] is not None
+                         and facts["p99_request_s"] <= spec.p99_budget_s,
+                         f"p99={facts['p99_request_s']}s"
+                         f" budget={spec.p99_budget_s}s"))
         return out
 
 
@@ -504,9 +655,18 @@ def summarize(spec: SwarmSpec, card: sc.Scorecard, facts: Dict) -> Dict:
             f"bkw_server_store_commits_total{{mode={mode}}}", 0)
         for mode in ("group", "direct")}
     p99 = facts.get("p99_request_s")
+    fed = {} if spec.nodes <= 1 else {
+        "nodes": spec.nodes,
+        "node_kills": facts.get("node_kills"),
+        "failovers": facts.get("failovers"),
+        "post_revive_matchmakings": facts.get("post_revive_matchmakings"),
+        "total_matchmakings": facts.get("total_matchmakings"),
+        "negotiated_rows": facts.get("negotiated_rows"),
+    }
     return {
         "tier": "legacy" if spec.legacy else "sharded",
         "clients": spec.clients,
+        **fed,
         "duration_s": facts.get("swarm_elapsed_s"),
         "matchmakings": facts.get("swarm_matchmakings"),
         "matchmakings_per_s": facts.get("matchmakings_per_s"),
@@ -645,4 +805,22 @@ def builtin_swarms() -> Dict[str, SwarmSpec]:
             name="swarm_full", seed=111, clients=192, think_s=0.02,
             phases=(P("register"), P("swarm", duration_s=6.0),
                     P("drain"))),
+        # federation acceptance: 3 nodes over one partitioned store,
+        # node kill + same-port revive mid-run; tier-1 sized.  WS churn
+        # is off — the nodekill phase IS the churn under test, and the
+        # kill already exercises every reconnect path
+        "federation": SwarmSpec(
+            name="federation", seed=202, clients=12, workers=4, nodes=3,
+            churn_every=0, think_s=0.005,
+            phases=(P("register"), P("swarm", duration_s=1.2),
+                    P("nodekill", duration_s=1.6), P("drain"))),
+        # slow-tier soak: more nodes, more clients, a second full swarm
+        # window after the revive so steady-state federation throughput
+        # is measured post-churn
+        "federation_soak": SwarmSpec(
+            name="federation_soak", seed=212, clients=48, nodes=4,
+            churn_every=0, think_s=0.02,
+            phases=(P("register"), P("swarm", duration_s=4.0),
+                    P("nodekill", duration_s=4.0),
+                    P("swarm", duration_s=3.0), P("drain"))),
     }

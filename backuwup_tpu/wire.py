@@ -404,6 +404,18 @@ ERROR_HTTP_STATUS = {
 
 
 @dataclass
+class NodeRedirect(JsonMessage):
+    """server -> client, HTTP 421: this pubkey's home is another
+    coordination node (federation wrong-node arrival; no reference
+    equivalent — the reference has exactly one server).  ``url`` is the
+    owning node's base URL; clients follow at most one redirect per
+    request and only toward a URL already on their configured node
+    list."""
+
+    url: str = ""
+
+
+@dataclass
 class Error(JsonMessage):
     # one of ErrorKind.ALL plus a human-readable detail
     kind: str = ErrorKind.FAILURE

@@ -261,6 +261,16 @@ def main() -> None:
     if "gc_reclaim_ratio" in gc:
         record["gc_reclaim_ratio"] = gc["gc_reclaim_ratio"]
         record["gc_passed"] = gc.get("passed")
+    # config #16 is the federated coordination plane: surface the
+    # multi-node matchmaking speedups at top level (scaling gates arm on
+    # >=4-CPU hosts; the churn scorecard's zero-lost gate runs
+    # everywhere) so BENCH_r*.json diffs track federation directly
+    federation = configs.get("16_federation", {})
+    if "federation_speedup_2node" in federation:
+        record["federation_speedup_2node"] = \
+            federation["federation_speedup_2node"]
+        record["federation_speedup_4node"] = \
+            federation["federation_speedup_4node"]
     print(json.dumps({
         **record,
         "note": "corpus synthesized on-device (host<->device relay tunnel "

@@ -1404,6 +1404,84 @@ def config14_multichip(log: Callable, n_devices: int = 0) -> Dict:
             "wall_s": round(w1.wall + w2.wall, 2)}
 
 
+def config16_federation(log: Callable) -> Dict:
+    """Federated coordination plane — config #16.
+
+    Two measurements land in ONE record:
+
+    * **scaling legs** — the SAME seeded client universe driven at
+      1, 2, and 4 nodes, each node a real OS process with its own
+      ServerStore partition file and real ``/fed/steal`` HTTP between
+      processes (scenario/federation.py).  ``federation_speedup_2node``
+      / ``_4node`` are always recorded; the throughput gates
+      (≥ ``BENCH_C16_SPEEDUP_GATE_2`` = 1.6x at 2 nodes,
+      ≥ ``BENCH_C16_SPEEDUP_GATE_4`` = 2.8x at 4 nodes) arm only when
+      the host has ≥ 4 CPUs (or ``BENCH_C16_FORCE_GATE=1``): node
+      processes timesharing one core measure scheduler overhead, not
+      scale — the config-14 precedent.
+    * **churn evidence** — the full HTTP federation swarm (3 nodes over
+      one partitioned store, client failover, a node kill + same-port
+      revive mid-run), embedding the scorecard whose hard gates assert
+      zero lost matchmakings (durable negotiation rows ≥ 2x total
+      matchmakings across every partition), post-revive matchmaking
+      flow, at least one client failover, and bounded per-route p99.
+    """
+    import asyncio
+    import dataclasses
+    import tempfile
+    from pathlib import Path
+
+    from backuwup_tpu.scenario import builtin_swarms, run_swarm
+    from backuwup_tpu.scenario.federation import (FederationLoadSpec,
+                                                  run_federation_load)
+
+    clients = int(os.environ.get("BENCH_C16_CLIENTS", "64"))
+    duration_s = float(os.environ.get("BENCH_C16_S", "2.0"))
+    spec = FederationLoadSpec(nodes=1, clients=clients,
+                              duration_s=duration_s)
+    legs = {}
+    with tempfile.TemporaryDirectory(prefix="bkw_bench_fed_") as td:
+        for n in (1, 2, 4):
+            legs[n] = run_federation_load(
+                dataclasses.replace(spec, nodes=n), Path(td) / f"n{n}")
+        card, swarm = asyncio.run(run_swarm(
+            builtin_swarms()["federation"], Path(td) / "churn"))
+    base = max(legs[1]["matchmakings_per_s"], 1e-9)
+    speedup2 = legs[2]["matchmakings_per_s"] / base
+    speedup4 = legs[4]["matchmakings_per_s"] / base
+    gate2 = float(os.environ.get("BENCH_C16_SPEEDUP_GATE_2", "1.6"))
+    gate4 = float(os.environ.get("BENCH_C16_SPEEDUP_GATE_4", "2.8"))
+    armed = ((os.cpu_count() or 1) >= 4
+             or os.environ.get("BENCH_C16_FORCE_GATE") == "1")
+    scaling_ok = (not armed) or (speedup2 >= gate2 and speedup4 >= gate4)
+    passed = scaling_ok and card.passed
+    mode = "gates armed" if armed else "gates recorded only, few-core host"
+    log(f"config#16 federation: {clients} clients x {duration_s:.1f}s: "
+        f"1n {legs[1]['matchmakings_per_s']:.0f} mm/s, "
+        f"2n {legs[2]['matchmakings_per_s']:.0f} ({speedup2:.2f}x), "
+        f"4n {legs[4]['matchmakings_per_s']:.0f} ({speedup4:.2f}x) "
+        f"({mode}); churn swarm: "
+        f"failovers={swarm['failovers']} rows={swarm['negotiated_rows']} "
+        f"mm={swarm['total_matchmakings']} p99={swarm['server_p99_ms']}ms "
+        f"[{'PASS' if passed else 'FAIL'}]")
+    return {"passed": passed,
+            "federation_speedup_2node": round(speedup2, 2),
+            "federation_speedup_4node": round(speedup4, 2),
+            "speedup_gate_armed": armed,
+            "matchmakings_per_s_1node": legs[1]["matchmakings_per_s"],
+            "matchmakings_per_s_2node": legs[2]["matchmakings_per_s"],
+            "matchmakings_per_s_4node": legs[4]["matchmakings_per_s"],
+            "steals_2node": legs[2]["steals"],
+            "steals_4node": legs[4]["steals"],
+            "churn_failovers": swarm["failovers"],
+            "churn_negotiated_rows": swarm["negotiated_rows"],
+            "churn_total_matchmakings": swarm["total_matchmakings"],
+            "server_p99_ms": swarm["server_p99_ms"],
+            "legs": {f"{n}node": legs[n] for n in (1, 2, 4)},
+            "swarm": swarm,
+            "scorecard": card.to_dict()}
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -1423,7 +1501,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("12_swarm", lambda: config12_swarm(log)),
             ("13_restore", lambda: config13_restore(log)),
             ("14_multichip", lambda: config14_multichip(log)),
-            ("15_gc", lambda: config15_gc(log))):
+            ("15_gc", lambda: config15_gc(log)),
+            ("16_federation", lambda: config16_federation(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

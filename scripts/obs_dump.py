@@ -8,6 +8,9 @@ Three read-only views, no accelerator and no repo imports beyond stdlib:
   non-zero samples, one per line, followed by estimated p50/p99 lines
   for each histogram series.  ``--watch N`` re-polls every N seconds
   and prints only the samples that changed, with their deltas.
+  Repeatable: several ``--url`` flags (a federation's nodes) print one
+  per-node section each plus a merged fleet view with samples summed;
+  ``--watch`` then tracks deltas of the merged view.
 * ``--journal PATH [-n N]`` — tail the last N parsed lines of a JSONL
   journal written under ``BKW_JOURNAL``; ``--trace TID`` filters to one
   correlated trace.  Repeatable: several clients' journals concatenate.
@@ -208,18 +211,43 @@ def _print_view(samples: dict, prev=None) -> None:
         print(line)
 
 
-def dump_metrics(url: str, raw: bool, watch: float) -> int:
-    samples = _parse(_fetch(url))
+def _merge(sample_maps) -> "dict[str, float]":
+    """Sum the same sample key across nodes.  Sound for counters and
+    histogram buckets (cumulative, monotone); gauges come out as a
+    fleet total, which the merged header says out loud."""
+    out: dict = {}
+    for samples in sample_maps:
+        for key, value in samples.items():
+            out[key] = out.get(key, 0.0) + value
+    return out
+
+
+def dump_metrics(urls, raw: bool, watch: float) -> int:
+    """One URL: the classic view.  Several (repeated ``--url``, e.g. a
+    federation's nodes): a per-node section each, then a merged view
+    with counters summed — the fleet-wide picture one grep away."""
     if raw and not watch:
-        sys.stdout.write(_fetch(url))
+        for url in urls:
+            sys.stdout.write(_fetch(url))
         return 0
-    _print_view(samples)
+
+    def poll():
+        per = [_parse(_fetch(u)) for u in urls]
+        return per, (_merge(per) if len(per) > 1 else per[0])
+
+    per, merged = poll()
+    if len(urls) > 1:
+        for url, samples in zip(urls, per):
+            print(f"== {url}")
+            _print_view(samples)
+        print(f"== merged ({len(urls)} nodes, samples summed)")
+    _print_view(merged)
     while watch:
         time.sleep(watch)
-        fresh = _parse(_fetch(url))
+        _, fresh = poll()
         print(f"--- {time.strftime('%H:%M:%S')} (+{watch:g}s)")
-        _print_view(fresh, prev=samples)
-        samples = fresh
+        _print_view(fresh, prev=merged)
+        merged = fresh
     return 0
 
 
@@ -263,7 +291,9 @@ def dump_panic(path: str) -> int:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     src = ap.add_mutually_exclusive_group(required=True)
-    src.add_argument("--url", help="base URL of a /metrics endpoint")
+    src.add_argument("--url", action="append",
+                     help="base URL of a /metrics endpoint (repeatable:"
+                          " per-node views plus a merged fleet view)")
     src.add_argument("--journal", action="append",
                     help="path to a BKW_JOURNAL JSONL file (repeatable:"
                          " merge several clients' journals)")

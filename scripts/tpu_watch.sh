@@ -151,6 +151,19 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "gc bench recapture FAILED (see $gcb) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated federation recapture: config #16 alone (host-only
+        # multi-process coordination plane: 1/2/4-node scaling legs over
+        # real /fed/steal HTTP plus the kill/revive churn swarm) — the
+        # federation_speedup_* numbers and the zero-lost verdict survive
+        # even when the device suite timed out partway
+        fed="$BENCH_OUT_DIR/BENCH_federation_${stamp}.json"
+        if timeout "${BENCH_FEDERATION_TIMEOUT_S:-900}" \
+                env BENCH_ONLY_CONFIG=16_federation BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$fed" 2>>/tmp/tpu_watch.log; then
+            echo "federation bench recaptured to $fed at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "federation bench recapture FAILED (see $fed) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

@@ -72,6 +72,11 @@ def pytest_configure(config):
         "swarm.py); the ~32-client acceptance run is tier-1, the full"
         " load shape is also marked slow")
     config.addinivalue_line(
+        "markers", "federation: multi-node coordination-plane tests"
+        " (net/ring.py, PartitionedServerStore, cross-node work"
+        " stealing, client failover); the ring/store units and the"
+        " 3-node kill/revive churn swarm are tier-1, the soak is slow")
+    config.addinivalue_line(
         "markers", "profile: timing-sensitive profiling tests"
         " (obs/profile.py dev timer); excluded from tier-1 like accel —"
         " set BKW_PROFILE_TESTS=1 to run them")

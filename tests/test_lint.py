@@ -397,9 +397,52 @@ def test_cli_write_baseline_round_trips(tmp_path):
                      "--write-baseline", str(bl)], out=out) == 0
     doc = json.loads(bl.read_text())
     assert doc["version"] == 1 and len(doc["entries"]) == 1
+    # the placeholder stamp gates: a suppression nobody justified is
+    # exit 3 until the entry is edited
+    out = io.StringIO()
+    rc = cli_main([str(root), "--rule", "BKW001", "--baseline", str(bl)],
+                  out=out)
+    assert rc == 3
+    assert "TODO placeholder" in out.getvalue()
+    doc["entries"][0]["justification"] = "deliberate: startup-only path"
+    bl.write_text(json.dumps(doc))
     rc = cli_main([str(root), "--rule", "BKW001", "--baseline", str(bl)],
                   out=io.StringIO())
     assert rc == 0
+
+
+def test_cli_write_baseline_with_justification(tmp_path):
+    """``--justification`` stamps every written entry with a real
+    reason, so the round trip is immediately clean."""
+    root = _one_finding_pkg(tmp_path)
+    bl = tmp_path / "bl.json"
+    assert cli_main([str(root), "--rule", "BKW001",
+                     "--write-baseline", str(bl),
+                     "--justification",
+                     "batch exception: legacy sync seam"],
+                    out=io.StringIO()) == 0
+    doc = json.loads(bl.read_text())
+    assert all(e["justification"] == "batch exception: legacy sync seam"
+               for e in doc["entries"])
+    assert cli_main([str(root), "--rule", "BKW001", "--baseline", str(bl)],
+                    out=io.StringIO()) == 0
+
+
+def test_unjustified_baseline_entries_reported(tmp_path):
+    """apply_baseline routes TODO-prefixed matched entries into
+    ``report.unjustified`` (json view included), and ``clean`` is
+    False until they are edited."""
+    root = _one_finding_pkg(tmp_path)
+    cfg = LintConfig(package_root=root, doc_path=None,
+                     baseline_path=None, rules={"BKW001"})
+    findings = collect_findings(cfg)
+    assert findings
+    baseline = {findings[0].key: "TODO: justify this exception"}
+    report = apply_baseline(findings, baseline)
+    assert not report.findings and not report.stale_baseline
+    assert [e["key"] for e in report.unjustified] == [findings[0].key]
+    assert not report.clean
+    assert report.to_dict()["unjustified"]
 
 
 # --- the repo-wide tier-1 gate ----------------------------------------------

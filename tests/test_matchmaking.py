@@ -139,6 +139,29 @@ def test_store_readable_after_close(tmp_path):
     store.close()  # idempotent
 
 
+def test_close_during_write_behind_drains_queue(tmp_path):
+    """Regression guard for the shutdown seam: a write accepted by the
+    write-behind queue must be either durably committed or loudly
+    failed BEFORE ``close()`` returns — a future silently left pending
+    is a write the caller was told nothing about.  (The server's stop
+    path closes the store while handlers may just have enqueued.)"""
+    store = SqliteServerStore(str(tmp_path / "s.db"))
+    writes = 200
+    futs = [store._submit(store._op_register_client, (pk(i),))
+            for i in range(writes)]
+    store.close()
+    pending = [f for f in futs if not f.done()]
+    assert not pending, f"{len(pending)} futures left pending after close()"
+    for f in futs:
+        f.result(timeout=0)  # raises if any write failed silently
+    for i in range(writes):
+        assert store.client_exists(pk(i))
+    # post-close writes still land via the inline fallback, immediately
+    # durable (close flips to direct commits, it does not drop writes)
+    store._submit(store._op_register_client, (pk(writes),)).result(timeout=0)
+    assert store.client_exists(pk(writes))
+
+
 # --- sharded matchmaking ----------------------------------------------------
 
 
