@@ -1,0 +1,14 @@
+"""Tiered dedup index: HBM-hot probe over a host LSM cold tier.
+
+``cold.py`` is the bucketed LSM-style host/disk fingerprint store
+(sorted immutable runs + memtable, crash-disciplined run commits);
+``tiered.py`` is the :class:`TieredDedupIndex` front that keeps the hot
+:class:`~backuwup_tpu.ops.dedup_index.ShardedDedupIndex` under the
+``DEDUP_HBM_BUDGET_BYTES`` cap by demoting cold fingerprints instead of
+growing 4x forever.  Architecture notes: docs/dedup_tiering.md.
+"""
+
+from .cold import ColdFingerprintStore
+from .tiered import TieredDedupIndex
+
+__all__ = ["ColdFingerprintStore", "TieredDedupIndex"]

@@ -300,3 +300,21 @@ BLAKE3_LEAF_BUCKETS = (16, 64, 256, 1024, 2048, 3072)
 # Sharded dedup index: default capacity per device shard (slots) and probe cap.
 DEDUP_SHARD_CAPACITY = 1 << 20
 DEDUP_MAX_PROBES = 32
+
+# --- tiered dedup index (dedupstore/, docs/dedup_tiering.md) -----------------
+# Ceiling on HBM bytes the hot fingerprint table may occupy across the whole
+# mesh: slots x 20 bytes (16-byte truncated key + u32 value) x n_devices.
+# When an insert would force a 4x growth past this cap, the tiered index
+# demotes cold fingerprints to the host LSM store instead of growing.
+DEDUP_HBM_BUDGET_BYTES = 256 * MiB
+# Cold-tier LSM store: memtable entries before a sorted run is committed,
+# prefix-bucket count per run (first key word, top bits), and the size-tiered
+# compaction fan-in (merge when a tier accumulates this many runs).
+DEDUP_COLD_MEMTABLE_LIMIT = 1 << 16
+DEDUP_COLD_BUCKETS = 256
+DEDUP_COLD_COMPACT_FANIN = 4
+# Promotion/demotion clock: one period every this many classify dispatch
+# windows; cold fingerprints hit at least PROMOTE_MIN_HITS times within a
+# period are promoted into the hot table.
+DEDUP_TIER_CLOCK_WINDOWS = 8
+DEDUP_TIER_PROMOTE_MIN_HITS = 2

@@ -44,6 +44,10 @@ from .audit import (
     select_challenges,
 )
 from .crypto import KeyManager
+# imported at module scope so the cold tier's crash sites register with
+# the live faults registry the moment the engine is importable (the
+# BKW003 static/live registry parity check depends on it)
+from .dedupstore import TieredDedupIndex
 from .erasure import gf_cpu
 from .erasure import stripe as rs_stripe
 from .net.client import NoBackups, ServerClient, ServerError
@@ -227,8 +231,7 @@ class Engine:
             dedup_mesh = self._default_mesh()
         self.device_dedup = None
         if dedup_mesh is not None:
-            from .snapshot.device_dedup import MeshDedupIndex
-            self.device_dedup = MeshDedupIndex(dedup_mesh, self.index)
+            self.device_dedup = self._make_device_dedup(dedup_mesh)
             # the manifest pipeline shards batches over the same mesh so
             # digests can hand off to the dedup table on device
             if hasattr(self.backend, "attach_mesh"):
@@ -272,6 +275,21 @@ class Engine:
             return None
 
     # --- paths -------------------------------------------------------------
+
+    def _make_device_dedup(self, mesh):
+        """Device dedup front for ``mesh``: tiered by default.
+
+        The tiered front keeps the HBM table under
+        ``DEDUP_HBM_BUDGET_BYTES`` with the LSM cold tier under the
+        store's data dir absorbing demoted fingerprints
+        (docs/dedup_tiering.md); ``BKW_DEDUP_TIERED=0`` falls back to
+        the grow-only :class:`MeshDedupIndex`.
+        """
+        if os.environ.get("BKW_DEDUP_TIERED", "1") != "0":
+            return TieredDedupIndex(
+                mesh, self.index, cold_dir=self.store.dedup_cold_dir())
+        from .snapshot.device_dedup import MeshDedupIndex
+        return MeshDedupIndex(mesh, self.index)
 
     def _pack_dir(self) -> Path:
         return self.store.packfile_dir()
@@ -1897,9 +1915,8 @@ class Engine:
             # from the pruned map so re-packed blobs are not misclassified
             # as duplicates
             if self.device_dedup is not None:
-                from .snapshot.device_dedup import MeshDedupIndex
-                self.device_dedup = MeshDedupIndex(
-                    self.device_dedup.mesh, self.index)
+                self.device_dedup = self._make_device_dedup(
+                    self.device_dedup.mesh)
             self._avoid_peers = set(lost)
             try:
                 bytes_replaced = await self._repack_and_send(bytes_lost)

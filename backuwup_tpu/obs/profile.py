@@ -101,6 +101,47 @@ _HBM_HIGH = _metrics.gauge(
     "window (buffers + packed cuts + digest accumulator + dedup lanes)",
     labelnames=("device",))
 
+# Tiered dedup index families (dedupstore/, docs/dedup_tiering.md): the
+# hot/cold/host probe split, the promotion/demotion clock, and the HBM
+# footprint of the hot fingerprint table.  Declared here (not in
+# dedupstore/) so every family has exactly one construction site and the
+# report below can fold the tier split into the per-backup delta.
+TIER_PATHS = ("device", "cold", "host")
+
+_TIER_PROBES = _metrics.counter(
+    "bkw_tier_probes_total",
+    "Tiered dedup probes by answering path (device = hot HBM table, "
+    "cold = host LSM fall-through, host = authority fallback)",
+    labelnames=("path",))
+_TIER_HITS = _metrics.counter(
+    "bkw_tier_hits_total",
+    "Tiered dedup probe hits (key classified duplicate) by answering "
+    "path", labelnames=("path",))
+_TIER_PROMOTIONS = _metrics.counter(
+    "bkw_tier_promotions_total",
+    "Fingerprints promoted cold -> hot by the probe-frequency clock")
+_TIER_DEMOTIONS = _metrics.counter(
+    "bkw_tier_demotions_total",
+    "Fingerprints demoted hot -> cold under the DEDUP_HBM_BUDGET_BYTES "
+    "cap")
+_TIER_HBM = _metrics.gauge(
+    "bkw_tier_hbm_bytes",
+    "Current HBM bytes held by the hot fingerprint table (slots x 20 "
+    "bytes x mesh devices)")
+_TIER_HBM_HIGH = _metrics.gauge(
+    "bkw_tier_hbm_highwater_bytes",
+    "Peak HBM bytes ever held by the hot fingerprint table")
+_TIER_COLD_RUNS = _metrics.gauge(
+    "bkw_tier_cold_runs",
+    "Sorted immutable runs on disk in the cold fingerprint store")
+_TIER_COLD_RECORDS = _metrics.gauge(
+    "bkw_tier_cold_records",
+    "Records across the cold store's runs + memtable (cross-run "
+    "duplicates counted until compaction merges them)")
+_TIER_COLD_COMMITS = _metrics.counter(
+    "bkw_tier_cold_run_commits_total",
+    "Durable cold-tier run commits by kind", labelnames=("kind",))
+
 # Span names whose bkw_span_seconds sums a pipeline report attributes as
 # per-stage wall time (the device pipeline's dispatch/collect pairs plus
 # the packer entry point that drives them).
@@ -165,6 +206,45 @@ def hbm_high_water(device: int, in_flight_bytes: int) -> None:
         _HBM_HIGH.set(in_flight_bytes, device=dev)
 
 
+# --- tiered dedup accounting (dedupstore/) -----------------------------------
+
+def tier_probes(path: str, probes: int, hits: int = 0) -> None:
+    """Record ``probes`` classify lanes answered on ``path`` (device /
+    cold / host), ``hits`` of which classified duplicate."""
+    if path not in TIER_PATHS:
+        raise ValueError(f"unknown tier path {path!r}")
+    if probes:
+        _TIER_PROBES.inc(probes, path=path)
+    if hits:
+        _TIER_HITS.inc(hits, path=path)
+
+
+def tier_promotions(n: int) -> None:
+    if n:
+        _TIER_PROMOTIONS.inc(n)
+
+
+def tier_demotions(n: int) -> None:
+    if n:
+        _TIER_DEMOTIONS.inc(n)
+
+
+def tier_hbm_bytes(table_bytes: int) -> None:
+    """Set the hot-table HBM gauge; the high-water twin only rises."""
+    _TIER_HBM.set(table_bytes)
+    if table_bytes > _TIER_HBM_HIGH.value():
+        _TIER_HBM_HIGH.set(table_bytes)
+
+
+def tier_cold_state(runs: int, records: int) -> None:
+    _TIER_COLD_RUNS.set(runs)
+    _TIER_COLD_RECORDS.set(records)
+
+
+def tier_cold_commit(kind: str) -> None:
+    _TIER_COLD_COMMITS.inc(1, kind=kind)
+
+
 # --- honest device timing (the scripts/devtime.py technique) ----------------
 
 def _sync(out):
@@ -227,6 +307,12 @@ def baseline() -> Dict[str, Dict[str, float]]:
         out["dispatch"][stage] = _DISPATCH.value(stage=stage)
         out["bytes"][stage] = _STAGE_BYTES.value(stage=stage)
         out["padded"][stage] = _STAGE_PADDED.value(stage=stage)
+    tier: Dict[str, float] = {"promotions": _TIER_PROMOTIONS.value(),
+                              "demotions": _TIER_DEMOTIONS.value()}
+    for path in TIER_PATHS:
+        tier[f"probes_{path}"] = _TIER_PROBES.value(path=path)
+        tier[f"hits_{path}"] = _TIER_HITS.value(path=path)
+    out["tier"] = tier
     spans = _metrics.registry().get("bkw_span_seconds")
     if spans is not None:
         for name in REPORT_SPANS:
@@ -278,6 +364,21 @@ def report(base: Optional[dict] = None) -> dict:
         "pad_efficiency": efficiency,
         "stage_seconds": stage_seconds,
     }
+    # tiered-dedup rows: probe/hit split per answering path plus the
+    # promotion/demotion clock movement, only when the tier moved at all
+    tier_delta = {k: int(v) for k, v in _delta("tier").items()}
+    if any(tier_delta.values()):
+        probes = {p: tier_delta[f"probes_{p}"] for p in TIER_PATHS}
+        hits = {p: tier_delta[f"hits_{p}"] for p in TIER_PATHS}
+        out["tier"] = {
+            "probes": probes,
+            "hits": hits,
+            "promotions": tier_delta["promotions"],
+            "demotions": tier_delta["demotions"],
+            "device_hit_rate": (round(hits["device"] / probes["device"], 6)
+                                if probes["device"] > 0 else None),
+            "hbm_highwater_bytes": int(_TIER_HBM_HIGH.value()),
+        }
     if by_device:
         out["device_dispatches"] = {
             d: by_device[d] for d in sorted(by_device, key=int)}

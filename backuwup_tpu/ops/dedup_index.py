@@ -190,6 +190,18 @@ class ShardedDedupIndex:
             mesh=self.mesh, axis=self.axis, capacity=new_capacity,
             keys=nk, values=nv, max_probes=self.max_probes)
 
+    def dump(self):
+        """Download every live entry to the host: ``(M, KEY_WORDS)`` u32
+        keys plus ``(M,)`` u32 values (empty slots — all-zero keys —
+        dropped).  This is the tiered index's demotion path
+        (``dedupstore/tiered.py``): the one sanctioned whole-table
+        download, rare by construction because it only runs when the
+        table hits the HBM budget cap."""
+        keys = np.asarray(self.keys).reshape(-1, KEY_WORDS)
+        values = np.asarray(self.values).reshape(-1)
+        live = keys.any(axis=1)
+        return keys[live], values[live]
+
     def _insert_once(self, queries: np.ndarray, values: np.ndarray):
         d = self.mesh.shape[self.axis]
         q, n = _pad_queries(queries, d)

@@ -686,6 +686,11 @@ class DevicePipeline:
                         "index", d,
                         actual_bytes=32 * int(sl[sl[:, 0] == 0, 1].sum()),
                         padded_bytes=32 * bs * cut_cap)
+                # tiered front (dedupstore.TieredDedupIndex): each
+                # collected batch is one promotion-clock window
+                note = getattr(dedup, "note_window", None)
+                if note is not None:
+                    note(n_real, int((lost != 0).sum()))
             hb = buf if isinstance(buf, np.ndarray) else None
             out: List = [None] * B
             flags: List = [None] * B

@@ -21,6 +21,7 @@ sharded over the mesh in HBM, probed via ``all_gather``/``psum`` collectives
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import List, Optional
 
 import numpy as np
@@ -53,7 +54,7 @@ class MeshDedupIndex:
         self.capacity = capacity or need
         # sharded all-ones value slabs for classify_dispatch, keyed by
         # per-shard lane count (insert_device never donates its value arg)
-        self._ones_cache: dict = {}
+        self._ones_cache: OrderedDict = OrderedDict()
         self._rebuild()
 
     def _rebuild(self) -> None:
@@ -106,11 +107,17 @@ class MeshDedupIndex:
             import jax.numpy as jnp
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
-            if len(self._ones_cache) > 16:
-                self._ones_cache.clear()
+            # LRU: evict the coldest lane count; the old wholesale
+            # clear() dropped hot entries (e.g. the full-batch lane
+            # count that recurs on every steady-state dispatch) on every
+            # 17th distinct shape (the pipeline _nv_cache idiom)
+            while len(self._ones_cache) >= 64:
+                self._ones_cache.popitem(last=False)
             v = self._ones_cache[n] = jax.device_put(
                 jnp.ones((d, n), dtype=jnp.uint32),
                 NamedSharding(self.mesh, P(self.axis)))
+        else:
+            self._ones_cache.move_to_end(n)
         return v
 
     def resolve_hints(self, hashes: List[bytes],

@@ -312,6 +312,12 @@ class Store:
         d.mkdir(parents=True, exist_ok=True)
         return d
 
+    def dedup_cold_dir(self) -> Path:
+        """Cold-tier fingerprint runs (dedupstore.ColdFingerprintStore)."""
+        d = self.data_base / "dedup_cold"
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
     def received_dir(self, peer_id: bytes) -> Path:
         d = self.data_base / "received_packfiles" / bytes(peer_id).hex()
         d.mkdir(parents=True, exist_ok=True)

@@ -271,6 +271,14 @@ def main() -> None:
             federation["federation_speedup_2node"]
         record["federation_speedup_4node"] = \
             federation["federation_speedup_4node"]
+    # config #17 is the tiered dedup index: surface the skewed-corpus
+    # device-path hit rate at top level (parity/budget/hit-rate gates
+    # run everywhere; the wall gate arms on hardware only) so
+    # BENCH_r*.json diffs track the tier split directly
+    tiered = configs.get("17_tiered", {})
+    if "tiered_hit_rate" in tiered:
+        record["tiered_hit_rate"] = tiered["tiered_hit_rate"]
+        record["tiered_overflow_ratio"] = tiered.get("overflow_ratio")
     print(json.dumps({
         **record,
         "note": "corpus synthesized on-device (host<->device relay tunnel "

@@ -38,7 +38,9 @@ Environment knobs: BENCH_C2_FILES, BENCH_C3_MIB, BENCH_C4_GIB,
 BENCH_C5_HASHES, BENCH_C6_MIB, BENCH_C7_SHARD_KIB, BENCH_C7_STRIPES,
 BENCH_C8_MIB, BENCH_C8_PEERS, BENCH_C8_LATENCY_S, BENCH_C10_KIB,
 BENCH_C10_CHUNK_KIB, BENCH_C12_CLIENTS, BENCH_C12_S, BENCH_C14_DEVICES,
-BENCH_C14_ROWS_PER_DEV, BENCH_C14_ROW_KIB, BENCH_C14_SPEEDUP_GATE.
+BENCH_C14_ROWS_PER_DEV, BENCH_C14_ROW_KIB, BENCH_C14_SPEEDUP_GATE,
+BENCH_C17_DEVICES, BENCH_C17_POPULATION, BENCH_C17_BATCH,
+BENCH_C17_HOT_FRACTION, BENCH_C17_HIT_GATE, BENCH_C17_WALL_GATE.
 """
 
 from __future__ import annotations
@@ -1482,6 +1484,174 @@ def config16_federation(log: Callable) -> Dict:
             "scorecard": card.to_dict()}
 
 
+def config17_tiered(log: Callable) -> Dict:
+    """Tiered dedup index — config #17 (docs/dedup_tiering.md).
+
+    One ``TieredDedupIndex`` is populated to ~12x its HBM budget (the
+    hot table is HARD-capped; the overflow demotes into the cold LSM
+    store), then probed through two legs:
+
+    * **skewed** — ``BENCH_C17_HOT_FRACTION`` (default 0.97) of every
+      batch drawn from a working set sized to fit the hot table, the
+      rest uniform over the whole population (the real-corpus shape:
+      incremental backups re-probe recent fingerprints)
+    * **uniform** — batches drawn uniformly over the population, the
+      adversarial shape that must fall through to the cold tier
+
+    Gates enforced on EVERY platform (CPU mesh included — all three
+    are deterministic counting/parity claims, not wall clock):
+
+      * parity — every classification during population bit-identical
+        to the BlobIndex oracle, and a post-population sample must
+        classify all-duplicate while fresh keys classify all-new
+      * budget — ``bkw_tier_hbm_highwater_bytes`` never exceeds the
+        budget while the population is >= 10x the hot slot count
+      * hit rate — the skewed leg answers > ``BENCH_C17_HIT_GATE``
+        (default 0.95) of its device probes on device
+        (``bkw_tier_hits/probes_total{path=device}`` deltas — the
+        ROADMAP's >95% device-path claim, surfaced as
+        ``tiered_hit_rate``)
+
+    The wall gate (skewed leg >= ``BENCH_C17_WALL_GATE`` x the uniform
+    leg's probe throughput, default 1.2) arms only on real hardware:
+    a forced CPU mesh timeshares the host with the cold tier's numpy
+    path, so the ratio measures dispatch overhead, not HBM locality.
+    """
+    import pathlib
+    import shutil
+    import tempfile
+
+    from jax.sharding import Mesh
+
+    from backuwup_tpu.crypto import KeyManager
+    from backuwup_tpu.dedupstore import TieredDedupIndex
+    from backuwup_tpu.obs import metrics as obs_metrics
+    from backuwup_tpu.snapshot.blob_index import BlobIndex
+
+    def _tier(name, **labels):
+        m = obs_metrics.registry().get(name)
+        return 0.0 if m is None else m.value(**labels)
+
+    n_dev = max(1, min(int(os.environ.get("BENCH_C17_DEVICES", "8")),
+                       jax.device_count()))
+    population = int(os.environ.get("BENCH_C17_POPULATION", "200000"))
+    batch = int(os.environ.get("BENCH_C17_BATCH", "4096"))
+    hot_frac = float(os.environ.get("BENCH_C17_HOT_FRACTION", "0.97"))
+    # budget sized so the population overflows the hot table ~12x
+    budget = max(population // 12, n_dev * 64) * 20
+    rng = np.random.default_rng(171)
+    hashes = [t.tobytes()
+              for t in rng.integers(0, 256, (population, 32),
+                                    dtype=np.uint8)]
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("data",))
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="bkw_bench_c17_"))
+    try:
+        host = BlobIndex(KeyManager.from_secret(b"\x11" * 32),
+                         tmp / "index")
+        ti = TieredDedupIndex(mesh, host, cold_dir=tmp / "cold",
+                              hbm_budget_bytes=budget,
+                              promote_min_hits=1)
+        total_slots = mesh.shape["data"] * ti.capacity
+        if population < 10 * total_slots:
+            raise RuntimeError(
+                f"config #17: population {population} < 10x hot slots "
+                f"{total_slots} — overflow claim would not be tested")
+        # --- populate to ~12x budget, parity-gated against the oracle
+        mismatches = 0
+        for s in range(0, population, 8192):
+            seg = hashes[s:s + 8192]
+            for h, f in zip(seg, ti.classify_insert(seg)):
+                if f != host.is_duplicate(h):
+                    mismatches += 1
+                host.mark_queued(h)
+        if mismatches:
+            raise RuntimeError(
+                f"config #17: {mismatches} oracle parity mismatches")
+        if _tier("bkw_tier_hbm_highwater_bytes") > budget:
+            raise RuntimeError("config #17: HBM budget exceeded")
+        # --- skewed leg: working set sized to the demotion keep-set
+        # (a quarter of the table) so churn from the uniform tail
+        # cannot push it out of HBM.  The hot lanes scan the set in
+        # rotation (an incremental re-backup re-probes every recent
+        # fingerprint, not a with-replacement sample — replacement
+        # would collapse hot lanes under per-batch dedup and inflate
+        # the tail's unique-lane share ~2x past ``1 - hot_frac``).
+        hot_n = max(total_slots // 4, batch)
+        hot_set = [hashes[i] for i in rng.integers(0, population, hot_n)]
+        for s in range(0, hot_n, batch):  # warm: promote the hot set
+            ti.classify_insert(hot_set[s:s + batch])
+        d0, h0 = (_tier("bkw_tier_probes_total", path="device"),
+                  _tier("bkw_tier_hits_total", path="device"))
+        w1 = SustainedWindow(4)
+        cursor = 0
+        for _ in w1.passes():
+            n_hot = int(batch * hot_frac)
+            leg = [hot_set[(cursor + i) % hot_n] for i in range(n_hot)]
+            cursor = (cursor + n_hot) % hot_n
+            leg += [hashes[int(i)] for i in
+                    rng.integers(0, population, batch - n_hot)]
+            if not all(ti.classify_insert(leg)):
+                raise RuntimeError("config #17: skewed leg parity FAILED")
+        d1, h1 = (_tier("bkw_tier_probes_total", path="device"),
+                  _tier("bkw_tier_hits_total", path="device"))
+        hit_rate = (h1 - h0) / max(d1 - d0, 1.0)
+        skew_pps = w1.count * batch / w1.wall
+        # --- uniform leg: the cold tier carries the tail
+        w2 = SustainedWindow(4)
+        for _ in w2.passes():
+            leg = [hashes[int(i)] for i in
+                   rng.integers(0, population, batch)]
+            if not all(ti.classify_insert(leg)):
+                raise RuntimeError("config #17: uniform leg parity FAILED")
+        uni_pps = w2.count * batch / w2.wall
+        # --- fresh keys still classify new after all the churn
+        fresh = [t.tobytes() for t in
+                 rng.integers(0, 256, (batch, 32), dtype=np.uint8)]
+        if any(ti.classify_insert(fresh)):
+            raise RuntimeError("config #17: fresh keys misclassified")
+        if _tier("bkw_tier_hbm_highwater_bytes") > budget:
+            raise RuntimeError("config #17: HBM budget exceeded post-legs")
+        hit_gate = float(os.environ.get("BENCH_C17_HIT_GATE", "0.95"))
+        if hit_rate < hit_gate:
+            raise RuntimeError(
+                f"config #17: device hit rate {hit_rate:.3f} < {hit_gate}")
+        speedup = skew_pps / max(uni_pps, 1e-9)
+        wall_gate = float(os.environ.get("BENCH_C17_WALL_GATE", "1.2"))
+        armed = jax.devices()[0].platform != "cpu"
+        if armed and speedup < wall_gate:
+            raise RuntimeError(
+                f"config #17: skewed/uniform {speedup:.2f}x < {wall_gate}x")
+        mode = ("wall gate armed" if armed
+                else "wall gate recorded only, CPU mesh")
+        log(f"config#17 tiered: {population} keys @ {total_slots} hot "
+            f"slots ({population / total_slots:.0f}x): skewed "
+            f"{skew_pps / 1e3:.0f}k probes/s hit {hit_rate:.3f}, uniform "
+            f"{uni_pps / 1e3:.0f}k probes/s = {speedup:.2f}x ({mode}; "
+            f"demotions {int(_tier('bkw_tier_demotions_total'))}, "
+            f"promotions {int(_tier('bkw_tier_promotions_total'))}, "
+            f"cold runs {int(_tier('bkw_tier_cold_runs'))})")
+        return {"population": population,
+                "hot_slots": total_slots,
+                "overflow_ratio": round(population / total_slots, 1),
+                "hbm_budget_bytes": budget,
+                "hbm_highwater_bytes":
+                    int(_tier("bkw_tier_hbm_highwater_bytes")),
+                "tiered_hit_rate": round(hit_rate, 4),
+                "hit_gate": hit_gate,
+                "parity_mismatches": mismatches,
+                "probes_per_s_skewed": round(skew_pps, 1),
+                "probes_per_s_uniform": round(uni_pps, 1),
+                "skew_speedup": round(speedup, 3),
+                "wall_gate_armed": armed,
+                "demotions": int(_tier("bkw_tier_demotions_total")),
+                "promotions": int(_tier("bkw_tier_promotions_total")),
+                "cold_runs": int(_tier("bkw_tier_cold_runs")),
+                "cold_records": int(_tier("bkw_tier_cold_records")),
+                "wall_s": round(w1.wall + w2.wall, 2)}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -1502,7 +1672,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("13_restore", lambda: config13_restore(log)),
             ("14_multichip", lambda: config14_multichip(log)),
             ("15_gc", lambda: config15_gc(log)),
-            ("16_federation", lambda: config16_federation(log))):
+            ("16_federation", lambda: config16_federation(log)),
+            ("17_tiered", lambda: config17_tiered(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

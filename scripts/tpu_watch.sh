@@ -164,6 +164,20 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "federation bench recapture FAILED (see $fed) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated tiered-dedup recapture: config #17 alone (the
+        # HBM-capped hot table over the host LSM cold tier: oracle
+        # parity + budget + >95% device hit rate always on; the
+        # skewed-vs-uniform wall gate arms on real chips where HBM
+        # locality is measurable) — the tiered_hit_rate number
+        # survives even when the device suite timed out partway
+        trd="$BENCH_OUT_DIR/BENCH_tiered_${stamp}.json"
+        if timeout "${BENCH_TIERED_TIMEOUT_S:-900}" \
+                env BENCH_ONLY_CONFIG=17_tiered BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$trd" 2>>/tmp/tpu_watch.log; then
+            echo "tiered bench recaptured to $trd at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "tiered bench recapture FAILED (see $trd) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

@@ -77,6 +77,11 @@ def pytest_configure(config):
         " stealing, client failover); the ring/store units and the"
         " 3-node kill/revive churn swarm are tier-1, the soak is slow")
     config.addinivalue_line(
+        "markers", "tiered: tiered dedup index tests (dedupstore/ hot"
+        " HBM probe over the LSM cold tier, docs/dedup_tiering.md); the"
+        " units and the 1e6-fingerprint parity gate are tier-1, the"
+        " 1e8 soak is also marked slow")
+    config.addinivalue_line(
         "markers", "profile: timing-sensitive profiling tests"
         " (obs/profile.py dev timer); excluded from tier-1 like accel —"
         " set BKW_PROFILE_TESTS=1 to run them")

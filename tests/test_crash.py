@@ -68,6 +68,9 @@ EXPECTED_SITES = {
     "gc.compact.seal.pre", "gc.compact.seal.post",
     "gc.swap.pre", "gc.swap.post",
     "gc.reclaim.pre", "gc.reclaim.post",
+    # the cold dedup tier's run commits (docs/dedup_tiering.md)
+    "tier.run.commit.pre", "tier.run.commit.post",
+    "tier.compact.commit.pre", "tier.compact.commit.post",
 }
 
 

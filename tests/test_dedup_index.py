@@ -4,12 +4,15 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from backuwup_tpu.ops.blake3_cpu import blake3_hash
 from backuwup_tpu.ops.dedup_index import (
+    KEY_WORDS,
     DedupIndexFull,
     ShardedDedupIndex,
     hashes_to_queries,
+    queries_from_cvs,
 )
 
 
@@ -96,3 +99,74 @@ def test_capacity_pressure_linear_probing(mesh):
     found = idx.insert(q, np.arange(256, dtype=np.uint32))
     assert (found == 0).all()
     assert (idx.probe(q) > 0).all()
+
+
+# --- query-construction edge rows ------------------------------------------
+
+
+def test_hashes_to_queries_edge_rows():
+    # empty input: a well-formed (0, 4) slab, not an exception
+    empty = hashes_to_queries([])
+    assert empty.shape == (0, KEY_WORDS) and empty.dtype == np.uint32
+    # exact little-endian word split of the first 16 bytes; bytes 16..31
+    # never reach the query (the 128-bit truncation)
+    h = bytes(range(32))
+    q = hashes_to_queries([h, h[:16] + b"\xff" * 16])
+    expect = np.frombuffer(h[:16], dtype="<u4")
+    assert np.array_equal(q[0], expect)
+    assert np.array_equal(q[0], q[1])
+    # memoryview/bytearray inputs coerce like bytes
+    q2 = hashes_to_queries([bytearray(h), memoryview(h)])
+    assert np.array_equal(q2[0], expect)
+
+
+def test_zero_query_rows_are_padding_for_probe_and_insert(mesh):
+    """All-zero rows are the kernels' padding convention: probe answers
+    0 and insert must not burn a slot or report found for them."""
+    idx = ShardedDedupIndex.create(mesh, capacity=64)
+    hs = _hashes(6, seed=21)
+    q = hashes_to_queries(hs)
+    padded = np.vstack([q[:3],
+                        np.zeros((2, KEY_WORDS), dtype=np.uint32),
+                        q[3:]])
+    found = idx.insert(padded, np.arange(8, dtype=np.uint32))
+    assert (found == 0).all()
+    # the real keys landed, the padding rows did not
+    assert (idx.probe(q) > 0).all()
+    assert (idx.probe(np.zeros((4, KEY_WORDS), dtype=np.uint32)) == 0).all()
+    # a second padded probe still reports 0 on the zero rows
+    again = idx.probe(padded)
+    assert (again[3:5] == 0).all() and (again[:3] > 0).all()
+
+
+def test_intra_batch_duplicate_fingerprints_single_resident(mesh):
+    """Occurrences of one fingerprint inside one insert batch all report
+    the pre-batch state ("new"), and exactly one occurrence's value ends
+    up resident (which one is a write race — the kernel's contract asks
+    for distinct keys per batch, and MeshDedupIndex.classify_insert's
+    host-side first-occurrence walk builds on exactly these semantics)."""
+    idx = ShardedDedupIndex.create(mesh, capacity=64)
+    h = _hashes(1, seed=22)[0]
+    q = hashes_to_queries([h, h, h])
+    found = idx.insert(q, np.array([4, 9, 13], dtype=np.uint32))
+    assert (found == 0).all()  # all report the pre-batch state
+    got = idx.probe(hashes_to_queries([h]))
+    assert int(got[0]) in (5, 10, 14)  # one occurrence's value (+1)
+    # and a later batch sees it as a plain duplicate with that value
+    again = idx.insert(q[:1], np.array([77], dtype=np.uint32))
+    assert int(again[0]) == int(got[0])
+
+
+def test_queries_from_cvs_matches_host_path():
+    """Slicing the accumulator on device == downloading digests and
+    calling hashes_to_queries; all-zero accumulator rows stay padding."""
+    rng = np.random.default_rng(23)
+    acc = rng.integers(0, 2 ** 32, (16, 8), dtype=np.uint32)
+    acc[4] = 0  # unplaced row (digest_pool scatters into zeros)
+    acc[11] = 0
+    q_dev = np.asarray(queries_from_cvs(jnp.asarray(acc)))
+    digests = [np.ascontiguousarray(row.astype("<u4")).tobytes()
+               for row in acc]
+    q_host = hashes_to_queries(digests)
+    assert np.array_equal(q_dev, q_host)
+    assert (q_dev[4] == 0).all() and (q_dev[11] == 0).all()

@@ -50,9 +50,17 @@ def _mesh(n):
     return Mesh(np.array(jax.devices()[:n]), ("data",))
 
 
-@pytest.mark.parametrize("n_dev", [1, 2, 8])
-@pytest.mark.parametrize("params", PARAM_SETS,
-                         ids=[str(p.desired_size) for p in PARAM_SETS])
+# The single-device 8K/16K legs ride the slow tier: tier-1 keeps the
+# full multi-device matrix plus the 4096 single-device leg, which
+# already pins the mesh-vs-single parity path — the larger desired
+# sizes change only the cut mask, covered by the 2/8-device legs.
+# (The tier-1 wall budget is a hard 870 s; see ROADMAP.md.)
+@pytest.mark.parametrize(
+    "params,n_dev",
+    [pytest.param(p, n, id=f"{p.desired_size}-{n}",
+                  marks=([pytest.mark.slow]
+                         if n == 1 and p.desired_size > 4096 else []))
+     for p in PARAM_SETS for n in (1, 2, 8)])
 def test_mesh_matches_single_device_and_oracle(params, n_dev):
     P = 65536
     rng = np.random.default_rng(13 * n_dev + params.desired_size)
