@@ -43,8 +43,20 @@ FAULTS_MODULE = "utils/faults.py"
 #: checked where application code invokes the helpers
 DURABLE_MODULE = "utils/durable.py"
 
-#: durable-commit helper tails (module-qualified or from-imported)
-COMMIT_HELPERS = ("commit_replace", "write_replace")
+#: durable-commit helper tails (module-qualified or from-imported).
+#: ``fsync_file`` joined when the replicated op log arrived: its append
+#: path commits via open-append + fsync rather than write_replace, and
+#: an un-injectable log append is exactly the torn-tail case the crash
+#: matrix exists to exercise.
+COMMIT_HELPERS = ("commit_replace", "write_replace", "fsync_file")
+
+#: replication commit points (net/serverstore.py).  The op-log methods
+#: are the durable edges of the ship/promote protocol — append (record
+#: durable on this node), set_epoch (fencing bump), truncate_after
+#: (divergent-tail amputation) — and ``_ship_tail`` is the ack barrier
+#: write futures resolve behind.  Each must sit next to a crashpoint
+#: for the same reason a write_replace must.
+_OPLOG_METHODS = ("append", "set_epoch", "truncate_after")
 
 
 def _is_crashpoint(norm: str) -> bool:
@@ -125,6 +137,11 @@ def _is_commit_seam(cs) -> Optional[str]:
     if parts[-1] == "flush" and len(parts) >= 2 \
             and parts[-2].endswith("index"):
         return "index.flush"
+    if parts[-1] in _OPLOG_METHODS and len(parts) >= 2 \
+            and parts[-2] == "log":
+        return f"oplog.{parts[-1]}"
+    if parts[-1] == "_ship_tail":
+        return "repl.ship"
     return None
 
 

@@ -52,6 +52,11 @@ DIAL_RETRY_CAP_S = 2.0
 DIAL_RETRY_ATTEMPTS = 2  # retries after the first dial (3 dials total)
 WS_RECONNECT_BASE_S = 0.2  # server push channel (net_server/mod.rs:26-55)
 WS_RECONNECT_CAP_S = 30.0
+# Grace given to in-flight handlers when a coordination node stops.
+# aiohttp's 60s default lets one live WebSocket push channel stall a
+# node's shutdown for a minute; clients reconnect elsewhere anyway, so
+# a stopping (or dying) node cuts stragglers fast.
+SERVER_SHUTDOWN_GRACE_S = 2.0
 STORAGE_REQUEST_RETRY_CAP_S = 60.0  # re-request backoff ceiling
 SEND_IDLE_BASE_S = 0.05  # send loop waiting on the packer
 SEND_IDLE_CAP_S = 0.25
@@ -279,6 +284,40 @@ FEDERATION_CLIENT_PIN_S = 10.0
 # an RPC storm that throttles local throughput (~4x on loopback) while
 # producing nothing.
 FEDERATION_STEAL_COOLDOWN_S = 0.05
+
+# --- replicated coordination metadata (net/serverstore.py ReplicatedServerStore,
+# net/server.py /repl/*, docs/server.md §Replication; no reference equivalent) -
+# Ring successors each partition's operation log ships to (the primary/
+# backup chain).  A write's future resolves only after the log record is
+# durable on the primary AND acked by at least one live successor, so 2
+# keeps a replica margin: after one permanent node loss the promoted
+# successor still ships to one live peer in a 3-node ring.
+REPL_SUCCESSORS = 2
+# Synchronous ship RPC (/repl/ship) timeout.  Shipping happens on the
+# store's writer thread inside the group commit, so this bounds the
+# latency a dead successor can add to a write batch before it is marked
+# down and the batch proceeds degraded.
+REPL_SHIP_TIMEOUT_S = 2.0
+# Extra full-chain retry rounds when a shipped batch collects ZERO
+# acks (serverstore.py _ship_tail).  Degraded mode (resolving write
+# futures no successor holds) is the last resort, not the first
+# response to one slow peer — each retry round ignores the ship-down
+# backoff and waits REPL_SHIP_RETRY_BASE_S * 2^round before trying.
+REPL_SHIP_RETRIES = 2
+REPL_SHIP_RETRY_BASE_S = 0.2
+# Forward/tail RPC deadline (net/server.py _repl_post).  Deliberately
+# LOOSER than the federation RPC timeout: a forward's owner is the only
+# correct target (there is no fallback peer to try), so a slow owner
+# should mean a slow request, not a failed one — timeouts here surface
+# as client-visible errors.  This bounds livelock, not latency.
+REPL_FORWARD_TIMEOUT_S = 10.0
+# Successor-side health probing of the primaries it backs: probe
+# interval and the consecutive-failure count that (together with every
+# more-senior chain member also being dead) triggers promotion.  The
+# promote deadline seen by clients is roughly INTERVAL x FAILURES plus
+# one replay.
+REPL_PROBE_INTERVAL_S = 2.0
+REPL_PROBE_FAILURES = 2
 
 # --- server-side TTLs (reference server/src/client_auth_manager.rs:17-20) ---
 AUTH_CHALLENGE_TTL_S = 30.0

@@ -37,7 +37,7 @@ from typing import Dict, List, Optional, Sequence
 
 from .. import defaults
 
-__all__ = ["HashRing", "partition_of"]
+__all__ = ["HashRing", "partition_of", "partition_key", "successors"]
 
 
 def _point(label: str) -> int:
@@ -55,6 +55,30 @@ def partition_of(pubkey: bytes, partitions: int) -> int:
     ``ShardedMatchmaker.shard_of``: big-endian 8-byte prefix, modulo)."""
     prefix = bytes(pubkey)[:8] or b"\x00"
     return int.from_bytes(prefix, "big") % max(1, int(partitions))
+
+
+def partition_key(partition: int) -> bytes:
+    """Deterministic ring key for a store partition *index*.
+
+    Replication homes whole partitions (a file-layout unit), not
+    individual pubkeys, so each partition needs one stable ring position
+    every node computes identically.  Hashing the label keeps partition
+    placement independent of the pubkey distribution."""
+    return hashlib.blake2b(b"bkw-partition:%d" % int(partition),
+                           digest_size=16).digest()
+
+
+def successors(ring: "HashRing", partition: int,
+               count: Optional[int] = None) -> List[str]:
+    """The replication chain for ``partition``: ring-successor nodes
+    after its owner, most-senior first, capped at ``count``
+    (``defaults.REPL_SUCCESSORS``).  Empty when the ring has one node
+    (standalone mode: no one to ship to)."""
+    owner = ring.owner(partition_key(partition))
+    if owner is None:
+        return []
+    limit = defaults.REPL_SUCCESSORS if count is None else int(count)
+    return ring.steal_order(owner)[:max(0, limit)]
 
 
 class HashRing:

@@ -37,7 +37,8 @@ from __future__ import annotations
 import asyncio
 import os
 import time
-from typing import Dict, Optional
+import urllib.request
+from typing import Dict, List, Optional, Set
 
 import json
 
@@ -52,7 +53,9 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from .matchmaking import (_MATCHMAKINGS, _QUEUE_DEPTH,  # noqa: F401
                           ShardedMatchmaker)
+from .ring import partition_key, successors as ring_successors
 from .serverstore import (_MIGRATIONS, _SCHEMA, SCHEMA_VERSION,  # noqa: F401
+                          ReplicatedServerStore, ReplicationFenced,
                           ServerDB, ServerStore, SqliteServerStore)
 
 _REQUESTS = obs_metrics.counter(
@@ -347,6 +350,25 @@ async def _obs_middleware(request, handler):
     try:
         with obs_trace.bind(trace_id), obs_trace.span(f"server{path}"):
             return await handler(request)
+    except ReplicationFenced as e:
+        # a zombie primary's write was refused by a higher-epoch chain:
+        # flip the local owner table and steer the client to the node
+        # that fenced us (it is either the new owner or knows it)
+        srv = request.app.get("bkw_server")
+        if srv is not None and e.owner and e.partition is not None \
+                and isinstance(srv.db, ReplicatedServerStore):
+            srv.db.set_owner(e.partition, e.owner)
+        url = srv.peers.get(e.owner) if (srv is not None and e.owner) \
+            else None
+        if url:
+            _RING_REDIRECTS.inc()
+            raise web.HTTPMisdirectedRequest(
+                text=wire.NodeRedirect(url=url).to_json(),
+                content_type="application/json")
+        raise web.HTTPConflict(
+            text=wire.Error(kind=wire.ErrorKind.RETRY,
+                            detail=str(e)).to_json(),
+            content_type="application/json")
     finally:
         _REQUEST_SECONDS.observe(time.monotonic() - t0, route=path)
 
@@ -398,6 +420,11 @@ class CoordinationServer:
         self._fed_http: Optional[aiohttp.ClientSession] = None
         self._peer_down_until: Dict[str, float] = {}
         self._steal_cooldown_until = 0.0
+        # replication state (dormant unless the store is replicated)
+        self._repl_chains: Dict[int, List[str]] = {}
+        self._probe_task: Optional[asyncio.Task] = None
+        self._probe_fail: Dict[str, int] = {}
+        self._dead_nodes: Set[str] = set()
 
     # --- helpers -----------------------------------------------------------
 
@@ -469,6 +496,281 @@ class CoordinationServer:
             self.queue.remote_steal = self._remote_steal
         self.connections.relay = self._relay_notify
         _RING_NODES.set(len(ring))
+        if isinstance(self.db, ReplicatedServerStore):
+            self._wire_replication()
+
+    # --- replication (docs/server.md §Replication) ---------------------------
+
+    def _partition_order(self, partition: int) -> List[str]:
+        """Takeover seniority for a partition: its ring owner, then the
+        ring successors — the same order every node computes, so exactly
+        one live node concludes it is next in line."""
+        owner = self.ring.owner(partition_key(partition))
+        order = [owner] if owner is not None else []
+        return order + [n for n in self.ring.steal_order(owner or "")
+                        if n not in order]
+
+    def _partition_chain(self, partition: int) -> List[str]:
+        """Successor chain from THIS node's perspective: the next
+        ``REPL_SUCCESSORS`` seniority members after wherever this node
+        sits, which after a takeover deliberately still includes the
+        original (dead) owner — ships to it fail harmlessly under
+        backoff until the zombie revives, at which point the first ship
+        re-fences it and it rejoins as a successor."""
+        order = [n for n in self._partition_order(partition)
+                 if n != self.node_id]
+        return order[:defaults.REPL_SUCCESSORS]
+
+    def _wire_replication(self) -> None:
+        store = self.db
+        owners: Dict[int, str] = {}
+        chains: Dict[int, List[str]] = {}
+        for i in range(len(store.parts)):
+            owners[i] = self.ring.owner(partition_key(i)) or self.node_id
+            chains[i] = (ring_successors(self.ring, i)
+                         if owners[i] == self.node_id else [])
+            self._repl_chains[i] = chains[i]
+        store.set_topology(owners=owners, successors=chains,
+                           ship=self._repl_ship)
+        store.forward_sync = self._repl_forward_sync
+        store.forward_async = self._repl_forward_async
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None and self.peers:
+            self._probe_task = loop.create_task(self._probe_loop())
+
+    def _repl_url(self, node_id: str, path: str) -> str:
+        url = self.peers.get(node_id)
+        if url is None:
+            raise ConnectionError(f"unknown peer {node_id!r}")
+        return url + path
+
+    def _repl_ship(self, node_id: str, payload: dict) -> dict:
+        """Sync ship hook for the store's WRITER THREAD (never the event
+        loop): POST one log tail to a successor's /repl/ship.  Synchrony
+        is the point — the batch's futures must not resolve until the
+        successor's ack (or a deliberate degraded decision) is in."""
+        req = urllib.request.Request(
+            self._repl_url(node_id, "/repl/ship"),
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(
+                req, timeout=defaults.REPL_SHIP_TIMEOUT_S) as resp:
+            return json.loads(resp.read())
+
+    def _repl_forward_sync(self, node_id: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            self._repl_url(node_id, "/repl/forward"),
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(
+                req, timeout=defaults.FEDERATION_RPC_TIMEOUT_S) as resp:
+            return json.loads(resp.read())
+
+    async def _repl_post(self, node_id: str, path: str, body: dict,
+                         op: str) -> dict:
+        """Replication RPC: like :meth:`_fed_post` but WITHOUT the
+        peer-down negative cache — a forward's owner (or a promote's
+        reconciliation source) is the only correct target, so failing
+        fast for the whole backoff window would turn one timed-out RPC
+        into seconds of refused writes.  Raises instead of None."""
+        url = self.peers.get(node_id)
+        if url is None:
+            raise ConnectionError(f"unknown peer {node_id!r}")
+        body = dict(body, trace_id=obs_trace.current_trace_id())
+        t0 = time.monotonic()
+        try:
+            async with self._fed_session().post(
+                    url + path, json=body,
+                    timeout=aiohttp.ClientTimeout(
+                        total=defaults.REPL_FORWARD_TIMEOUT_S)) as resp:
+                doc = await resp.json()
+            if resp.status != 200:
+                raise ConnectionError(
+                    f"{path} to {node_id!r}: HTTP {resp.status}")
+            return doc
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            # str(asyncio.TimeoutError()) is empty — name the type so
+            # the log line says WHAT failed, not just that it did
+            raise ConnectionError(
+                f"{path} to {node_id!r} failed:"
+                f" {e or type(e).__name__}") from e
+        finally:
+            _FED_RPC_SECONDS.observe(time.monotonic() - t0, op=op)
+
+    async def _repl_forward_async(self, node_id: str, body: dict) -> dict:
+        return await self._repl_post(node_id, "/repl/forward", body,
+                                     op="forward")
+
+    async def _probe_peer(self, node_id: str) -> bool:
+        """One liveness probe: any HTTP answer (even an unhealthy 503)
+        means the process is alive — promotion is for DEAD primaries,
+        not degraded ones."""
+        url = self.peers.get(node_id)
+        if url is None:
+            return False
+        try:
+            async with self._fed_session().get(url + "/healthz") as resp:
+                await resp.read()
+            return True
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            return False
+
+    async def _probe_loop(self) -> None:
+        while True:
+            await asyncio.sleep(defaults.REPL_PROBE_INTERVAL_S)
+            try:
+                await self._probe_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # probes must never kill the loop
+                continue
+
+    async def _probe_once(self) -> None:
+        store = self.db
+        # who do we care about? every current owner of a partition whose
+        # chain we sit on, plus everyone senior to us there (we defer to
+        # a live senior rather than racing it to promote)
+        for node in list(self.peers):
+            if await self._probe_peer(node):
+                self._probe_fail[node] = 0
+                self._dead_nodes.discard(node)
+            else:
+                self._probe_fail[node] = self._probe_fail.get(node, 0) + 1
+                if self._probe_fail[node] >= defaults.REPL_PROBE_FAILURES:
+                    self._dead_nodes.add(node)
+        for i in range(len(store.parts)):
+            owner = store.owners.get(i)
+            if owner == self.node_id or owner not in self._dead_nodes:
+                continue
+            order = self._partition_order(i)
+            if self.node_id not in order:
+                continue
+            seniors = order[:order.index(self.node_id)]
+            if any(n != owner and n not in self._dead_nodes
+                   for n in seniors):
+                continue  # a live senior will take it
+            await self._promote_partition(i)
+
+    async def _promote_partition(self, partition: int) -> None:
+        """Promote-on-death: reconcile the log with the surviving chain
+        members, replay the tail, assume ownership, re-chain, announce.
+
+        Reconciliation first: the dead primary needed only ONE ack per
+        batch, so a sibling successor may hold acked records this node
+        never saw.  Pull every live chain member's tail past our lsn and
+        merge it (accept_ship dedupes) BEFORE the epoch bump — promoting
+        around the longest surviving log is what makes 'acked by >=1
+        live successor' equal 'survives the primary's death'."""
+        part = self.db.parts[partition]
+        order = [n for n in self._partition_order(partition)
+                 if n != self.node_id]
+        for node in order[:defaults.REPL_SUCCESSORS + 1]:
+            if node in self._dead_nodes:
+                continue
+            # a live sibling may hold the ONLY surviving copy of an
+            # acked record, so one failed pull gets one retry before
+            # this node promotes around a shorter log
+            doc = None
+            for attempt in (0, 1):
+                try:
+                    doc = await self._repl_post(
+                        node, "/repl/tail",
+                        {"partition": int(partition),
+                         "after_lsn": part.log.last_lsn}, op="tail")
+                    break
+                except ConnectionError:
+                    if attempt == 0:
+                        await asyncio.sleep(0.2)
+            if doc is None:
+                continue
+            if doc.get("records"):
+                await asyncio.to_thread(self.db.accept_ship, {
+                    "partition": int(partition),
+                    "epoch": max(int(doc.get("epoch", 0)),
+                                 part.log.epoch),
+                    "from_lsn": part.log.last_lsn + 1,
+                    "records": doc["records"]})
+        epoch = await asyncio.to_thread(self.db.promote, partition)
+        chain = self._partition_chain(partition)
+        self._repl_chains[partition] = chain
+        self.db.set_topology(successors={partition: chain},
+                             ship=self._repl_ship)
+        body = {"partition": int(partition), "epoch": int(epoch),
+                "owner": self.node_id}
+        for node in list(self.peers):
+            await self._fed_post(node, "/repl/promote", body, op="promote")
+
+    async def repl_ship(self, request):
+        """Inter-node RPC: successor intake for one shipped log tail
+        (store-level accept_ship does epoch fencing, gap detection, and
+        the durable append — on the writer-pool thread, never here)."""
+        if not isinstance(self.db, ReplicatedServerStore):
+            raise self._err(wire.ErrorKind.BAD_REQUEST,
+                            "replication not enabled")
+        try:
+            doc = json.loads(await request.text())
+            resp = await asyncio.to_thread(self.db.accept_ship, doc)
+        except (ValueError, KeyError, TypeError, IndexError) as e:
+            raise self._err(wire.ErrorKind.BAD_REQUEST, str(e))
+        return web.json_response(resp)
+
+    async def repl_promote(self, request):
+        """Inter-node RPC: a promotion announcement.  Adopt the new
+        owner for the partition when the epoch is no older than ours —
+        a zombie primary hearing this learns it was superseded."""
+        if not isinstance(self.db, ReplicatedServerStore):
+            raise self._err(wire.ErrorKind.BAD_REQUEST,
+                            "replication not enabled")
+        try:
+            doc = json.loads(await request.text())
+            partition = int(doc["partition"])
+            epoch = int(doc["epoch"])
+            owner = str(doc["owner"])
+            part = self.db.parts[partition]
+        except (ValueError, KeyError, TypeError, IndexError) as e:
+            raise self._err(wire.ErrorKind.BAD_REQUEST, str(e))
+        if epoch >= part.log.epoch:
+            was_owner = self.db.owners.get(partition) == self.node_id
+            self.db.set_owner(partition, owner)
+            if owner != self.node_id and was_owner:
+                # we were the primary and just learned we are not: stop
+                # accepting writes NOW, not at the next fenced ship
+                part.fenced = True
+        return web.json_response({"ok": True, "epoch": part.log.epoch})
+
+    async def repl_tail(self, request):
+        """Inter-node RPC: read this node's log records past a given
+        lsn for one partition — the promote-time reconciliation pull."""
+        if not isinstance(self.db, ReplicatedServerStore):
+            raise self._err(wire.ErrorKind.BAD_REQUEST,
+                            "replication not enabled")
+        try:
+            doc = json.loads(await request.text())
+            resp = await asyncio.to_thread(
+                self.db.log_tail, int(doc["partition"]),
+                int(doc["after_lsn"]))
+        except (ValueError, KeyError, TypeError, IndexError) as e:
+            raise self._err(wire.ErrorKind.BAD_REQUEST, str(e))
+        return web.json_response(resp)
+
+    async def repl_forward(self, request):
+        """Inter-node RPC: execute one store op on a LOCAL partition for
+        a node that does not own it (the store's forward hooks land
+        here).  Never re-forwards — a stale sender gets wrong_owner."""
+        if not isinstance(self.db, ReplicatedServerStore):
+            raise self._err(wire.ErrorKind.BAD_REQUEST,
+                            "replication not enabled")
+        try:
+            doc = json.loads(await request.text())
+            resp = await asyncio.to_thread(
+                self.db.execute_local, int(doc["partition"]),
+                str(doc["op"]), list(doc.get("args") or []))
+        except (ValueError, KeyError, TypeError, IndexError) as e:
+            raise self._err(wire.ErrorKind.BAD_REQUEST, str(e))
+        return web.json_response(resp)
 
     def _fed_session(self) -> aiohttp.ClientSession:
         if self._fed_http is None or self._fed_http.closed:
@@ -623,7 +925,14 @@ class CoordinationServer:
         client list costs latency, never a matchmaking."""
         if self.ring is None:
             return
-        owner = self.ring.owner(pubkey)
+        if isinstance(self.db, ReplicatedServerStore):
+            # replication routes by partition OWNERSHIP (which promotion
+            # moves), not raw ring position — redirect to wherever the
+            # pubkey's partition currently lives.  Serving in place
+            # stays correct: foreign-partition ops forward to the owner.
+            owner = self.db.owners.get(self.db.partition_index(pubkey))
+        else:
+            owner = self.ring.owner(pubkey)
         if owner is None or owner == self.node_id:
             return
         url = self.peers.get(owner)
@@ -834,8 +1143,13 @@ class CoordinationServer:
             web.post("/repair/report", self.repair_report),
             web.post("/fed/steal", self.fed_steal),
             web.post("/fed/notify", self.fed_notify),
+            web.post("/repl/ship", self.repl_ship),
+            web.post("/repl/promote", self.repl_promote),
+            web.post("/repl/tail", self.repl_tail),
+            web.post("/repl/forward", self.repl_forward),
             web.get("/ws", self.ws),
         ])
+        app["bkw_server"] = self
         return app
 
     async def start(self, host="127.0.0.1", port=0,
@@ -845,12 +1159,20 @@ class CoordinationServer:
         testing, requests.rs:246-258, docs/src/client.md:22)."""
         self._runner = web.AppRunner(self.app())
         await self._runner.setup()
-        site = web.TCPSite(self._runner, host, port, ssl_context=ssl_context)
+        site = web.TCPSite(self._runner, host, port, ssl_context=ssl_context,
+                           shutdown_timeout=defaults.SERVER_SHUTDOWN_GRACE_S)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]
         return self.port
 
     async def stop(self) -> None:
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except asyncio.CancelledError:
+                pass
+            self._probe_task = None
         if self._fed_http is not None:
             if not self._fed_http.closed:
                 await self._fed_http.close()

@@ -279,6 +279,14 @@ def main() -> None:
     if "tiered_hit_rate" in tiered:
         record["tiered_hit_rate"] = tiered["tiered_hit_rate"]
         record["tiered_overflow_ratio"] = tiered.get("overflow_ratio")
+    # config #18 is replicated coordination metadata: surface the
+    # permakill durability count (must stay 0) and the promote-to-
+    # serving time at top level so BENCH_r*.json diffs track the
+    # replication plane directly
+    repl = configs.get("18_replication", {})
+    if "replication_lost_rows" in repl:
+        record["replication_lost_rows"] = repl["replication_lost_rows"]
+        record["repl_promote_s"] = repl.get("repl_promote_s")
     print(json.dumps({
         **record,
         "note": "corpus synthesized on-device (host<->device relay tunnel "

@@ -1652,6 +1652,75 @@ def config17_tiered(log: Callable) -> Dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def config18_replication(log: Callable) -> Dict:
+    """Replicated coordination metadata — config #18 (docs/server.md
+    §Replication).
+
+    Two swarm runs land in ONE record:
+
+    * **permakill leg** — the builtin ``replication`` swarm: 3 nodes
+      with PER-NODE ``ReplicatedServerStore``s (nothing shared), a
+      partition-owning node killed for good mid-run.  Hard gates ride
+      the scorecard: a ring successor promoted within the probe
+      deadline, matchmaking flowed post-promotion, and zero durable
+      negotiation rows lost (``replication_lost_rows`` is recorded
+      top-level and must be 0 — the rows' only applier is gone, so
+      every surviving row crossed the ship-before-ack barrier).
+    * **shared-store baseline** — the SAME spec (clients, think time,
+      total load-window duration) with one shared partitioned store
+      behind the nodes and no kill: the only differences from the
+      permakill leg are the ship barrier and the death, so the rate
+      ratio prices the synchronous log ship.  Recorded, not gated —
+      one-core hosts measure scheduler noise, the config-16 precedent.
+    """
+    import asyncio
+    import dataclasses
+    import tempfile
+    from pathlib import Path
+
+    from backuwup_tpu.scenario import Phase, builtin_swarms, run_swarm
+
+    spec = builtin_swarms()["replication"]
+    load_s = sum(p.duration_s or 0.0 for p in spec.phases)
+    baseline = dataclasses.replace(
+        spec, name="replication_shared_baseline", shared_store=True,
+        phases=(Phase("register"), Phase("swarm", duration_s=load_s),
+                Phase("drain")))
+    with tempfile.TemporaryDirectory(prefix="bkw_bench_repl_") as td:
+        repl_card, repl = asyncio.run(run_swarm(spec, Path(td) / "repl"))
+        base_card, base = asyncio.run(run_swarm(
+            baseline, Path(td) / "shared"))
+    lost = max(0, 2 * repl["total_matchmakings"]
+               - repl["negotiated_rows"])
+    repl_rate = repl["total_matchmakings"] / max(repl_card.elapsed_s,
+                                                 1e-9)
+    base_rate = base["total_matchmakings"] / max(base_card.elapsed_s,
+                                                 1e-9)
+    passed = (repl_card.passed and base_card.passed and lost == 0
+              and repl["promotions"] >= 1)
+    log(f"config#18 replication: permakill leg "
+        f"mm={repl['total_matchmakings']} rows={repl['negotiated_rows']}"
+        f" lost={lost} promote={repl['repl_promote_s']}s"
+        f" ({repl_rate:.0f} mm/s); shared baseline "
+        f"mm={base['total_matchmakings']} ({base_rate:.0f} mm/s, "
+        f"ship cost {repl_rate / max(base_rate, 1e-9):.2f}x) "
+        f"[{'PASS' if passed else 'FAIL'}]")
+    return {"passed": passed,
+            "replication_lost_rows": lost,
+            "repl_promote_s": repl["repl_promote_s"],
+            "promotions": repl["promotions"],
+            "post_promote_matchmakings":
+                repl["post_promote_matchmakings"],
+            "matchmakings_per_s_replicated": round(repl_rate, 2),
+            "matchmakings_per_s_shared": round(base_rate, 2),
+            "ship_cost_ratio": round(repl_rate / max(base_rate, 1e-9),
+                                     3),
+            "server_p99_ms": repl["server_p99_ms"],
+            "swarm": repl,
+            "baseline_swarm": base,
+            "scorecard": repl_card.to_dict()}
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -1673,7 +1742,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("14_multichip", lambda: config14_multichip(log)),
             ("15_gc", lambda: config15_gc(log)),
             ("16_federation", lambda: config16_federation(log)),
-            ("17_tiered", lambda: config17_tiered(log))):
+            ("17_tiered", lambda: config17_tiered(log)),
+            ("18_replication", lambda: config18_replication(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

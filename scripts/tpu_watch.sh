@@ -178,6 +178,19 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "tiered bench recapture FAILED (see $trd) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated replication recapture: config #18 alone (host-only
+        # coordination plane: the 3-node permakill swarm over per-node
+        # replicated stores plus the shared-store baseline) — the
+        # replication_lost_rows=0 verdict and repl_promote_s survive
+        # even when the device suite timed out partway
+        rpl="$BENCH_OUT_DIR/BENCH_replication_${stamp}.json"
+        if timeout "${BENCH_REPLICATION_TIMEOUT_S:-900}" \
+                env BENCH_ONLY_CONFIG=18_replication BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$rpl" 2>>/tmp/tpu_watch.log; then
+            echo "replication bench recaptured to $rpl at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "replication bench recapture FAILED (see $rpl) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

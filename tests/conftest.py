@@ -82,6 +82,12 @@ def pytest_configure(config):
         " units and the 1e6-fingerprint parity gate are tier-1, the"
         " 1e8 soak is also marked slow")
     config.addinivalue_line(
+        "markers", "replication: replicated coordination-metadata tests"
+        " (op-log shipping, epoch fencing, promote-on-death,"
+        " docs/server.md §Replication); the protocol units and the"
+        " 3-node permakill swarm are tier-1, the soak and the kill-9"
+        " promote e2e are also marked slow")
+    config.addinivalue_line(
         "markers", "profile: timing-sensitive profiling tests"
         " (obs/profile.py dev timer); excluded from tier-1 like accel —"
         " set BKW_PROFILE_TESTS=1 to run them")

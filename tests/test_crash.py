@@ -33,6 +33,7 @@ import pytest
 from backuwup_tpu import defaults, wire
 from backuwup_tpu.crypto import KeyManager
 from backuwup_tpu.engine import Engine
+from backuwup_tpu.net import serverstore as _serverstore  # noqa: F401
 from backuwup_tpu.net.p2p import PartialStore, ReceivedFilesWriter
 from backuwup_tpu.obs import journal as obs_journal
 from backuwup_tpu.obs import metrics as obs_metrics
@@ -71,6 +72,10 @@ EXPECTED_SITES = {
     # the cold dedup tier's run commits (docs/dedup_tiering.md)
     "tier.run.commit.pre", "tier.run.commit.post",
     "tier.compact.commit.pre", "tier.compact.commit.post",
+    # the replicated op log's commit points (docs/server.md §Replication)
+    "repl.log.append.pre", "repl.log.append.post",
+    "repl.ship.acked",
+    "repl.promote.pre", "repl.promote.post",
 }
 
 

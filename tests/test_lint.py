@@ -193,6 +193,69 @@ def test_bkw003_lexical_callee_and_caller_coverage(tmp_path):
         "\n".join(f.render() for f in report.findings)
 
 
+_REPL_PKG_BODY = (
+    "from .utils import durable, faults\n"
+    "{consts}"
+    "class OpLog:\n"
+    "    def append(self, recs):\n"
+    "        durable.fsync_file('p')\n"
+    "    def set_epoch(self, e):\n"
+    "        pass\n"
+    "    def truncate_after(self, lsn):\n"
+    "        pass\n"
+    "class Part:\n"
+    "    def __init__(self):\n"
+    "        self.log = OpLog()\n"
+    "    def _ship_tail(self, recs):\n"
+    "        pass\n"
+    "    def batch(self, recs):\n"
+    "{batch_cp}"
+    "        self.log.append(recs)\n"
+    "        self._ship_tail(recs)\n"
+    "    def promote(self):\n"
+    "{promote_cp}"
+    "        self.log.set_epoch(1)\n"
+    "    def adopt(self):\n"
+    "{adopt_cp}"
+    "        self.log.truncate_after(0)\n")
+
+
+def test_bkw003_replication_seams_require_crashpoints(tmp_path):
+    """The op-log commit points (append / set_epoch / truncate_after),
+    the ship-ack barrier, and the fsync-append helper are commit seams:
+    bare, each one is a finding."""
+    root = _mk_pkg(tmp_path, {
+        "utils/faults.py": _FAULTS_STUB,
+        "utils/durable.py": "def fsync_file(p):\n    pass\n",
+        "a.py": _REPL_PKG_BODY.format(
+            consts="", batch_cp="", promote_cp="", adopt_cp="")})
+    report = _lint(root, {"BKW003"})
+    seams = {f.message.split("(")[1].split(")")[0]
+             for f in report.findings if "commit seam" in f.message}
+    assert seams == {"durable.fsync_file", "oplog.append", "repl.ship",
+                     "oplog.set_epoch", "oplog.truncate_after"}
+
+
+def test_bkw003_replication_seams_covered_by_adjacent_crashpoints(tmp_path):
+    """Crashpoints lexically beside each replication commit point clear
+    every seam — including durable.fsync_file inside OpLog.append,
+    covered through its direct caller (the same rule that clears the
+    stage-on-executor idiom)."""
+    root = _mk_pkg(tmp_path, {
+        "utils/faults.py": _FAULTS_STUB,
+        "utils/durable.py": "def fsync_file(p):\n    pass\n",
+        "a.py": _REPL_PKG_BODY.format(
+            consts=("_CP_A = faults.register_crash_site('r.append')\n"
+                    "_CP_P = faults.register_crash_site('r.promote')\n"
+                    "_CP_T = faults.register_crash_site('r.adopt')\n"),
+            batch_cp="        faults.crashpoint(_CP_A)\n",
+            promote_cp="        faults.crashpoint(_CP_P)\n",
+            adopt_cp="        faults.crashpoint(_CP_T)\n")})
+    report = _lint(root, {"BKW003"})
+    assert report.findings == [], \
+        "\n".join(f.render() for f in report.findings)
+
+
 def test_bkw003_unregistered_site_literal(tmp_path):
     root = _mk_pkg(tmp_path, {
         "utils/faults.py": _FAULTS_STUB,

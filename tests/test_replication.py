@@ -1,0 +1,503 @@
+"""Replicated coordination metadata: op log, shipping, fencing, promote.
+
+The protocol's commit seams get the ALICE/CrashMonkey treatment
+(docs/crash_consistency.md): each registered ``repl.*`` crashpoint is
+armed, the op is driven to the injected crash, and a REOPEN over the
+same files must land in a clean state — either the write never became
+durable anywhere (no caller was acked) or a replay/promote applies it
+exactly once.  Alongside the seams: epoch fencing (a zombie primary's
+stale commits refused, its divergent tail truncated when it rejoins as
+a successor), gap refill after a dark successor returns, promote-time
+reconciliation (the sibling with the longest acked log wins), and the
+3-node permakill swarm — kill a partition owner for good and lose
+nothing.
+
+Two in-process `ReplicatedServerStore`s wired with a direct function
+ship hook stand in for the HTTP pair; the swarm and the kill-9 e2e
+cover the real server layer.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from backuwup_tpu.net.serverstore import (OpLog, ReplicatedServerStore,
+                                          ReplicationFenced,
+                                          decode_value, encode_value)
+from backuwup_tpu.obs import metrics as obs_metrics
+from backuwup_tpu.scenario import builtin_swarms, run_swarm
+from backuwup_tpu.utils import faults
+
+pytestmark = pytest.mark.replication
+
+PARTS = 2
+MIB = 1024 * 1024
+REPO = Path(__file__).resolve().parent.parent
+
+
+def pk(i: int) -> bytes:
+    return i.to_bytes(8, "big") + bytes(24)  # partition = i % PARTS
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    obs_metrics.registry().reset()
+    yield
+    obs_metrics.registry().reset()
+    faults.uninstall()
+
+
+@pytest.fixture
+def plane():
+    return faults.install(faults.FaultPlane(seed=7))
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def _pair(root, chain=("n1",)):
+    """Two (or more) wired in-process nodes: n0 owns every partition,
+    ships to ``chain`` through a direct function hook."""
+    stores = {"n0": ReplicatedServerStore(root / "n0", "n0", PARTS)}
+    for nid in chain:
+        stores[nid] = ReplicatedServerStore(root / nid, nid, PARTS)
+
+    def ship(node, payload):
+        return stores[node].accept_ship(payload)
+
+    owners = {i: "n0" for i in range(PARTS)}
+    stores["n0"].set_topology(owners=owners,
+                              successors={i: list(chain)
+                                          for i in range(PARTS)},
+                              ship=ship)
+    for nid in chain:
+        stores[nid].set_topology(owners=owners)
+    return stores
+
+
+def _close_all(stores):
+    for s in stores.values():
+        s.close()
+
+
+# --- the op log -------------------------------------------------------------
+
+
+def test_oplog_roundtrip_tail_and_torn_tail(tmp_path):
+    log = OpLog(tmp_path / "p.log")
+    recs = [{"lsn": i, "epoch": 0, "op": "register_client",
+             "args": encode_value([pk(i)]), "ts": 1.0} for i in (1, 2, 3)]
+    log.append(recs)
+    assert log.last_lsn == 3
+    assert [r["lsn"] for r in log.tail(1)] == [2, 3]
+    # torn tail: a crash mid-append leaves a half-written last line
+    with open(tmp_path / "p.log", "ab") as fh:
+        fh.write(b'{"lsn": 4, "epo')
+    re1 = OpLog(tmp_path / "p.log")
+    assert [r["lsn"] for r in re1.records] == [1, 2, 3]
+    assert decode_value(re1.records[0]["args"]) == [pk(1)]
+
+
+def test_oplog_epoch_sidecar_and_truncate(tmp_path):
+    log = OpLog(tmp_path / "p.log")
+    log.append([{"lsn": 1, "epoch": 0, "op": "x", "args": [], "ts": 0},
+                {"lsn": 2, "epoch": 0, "op": "x", "args": [], "ts": 0}])
+    log.set_epoch(3)
+    log.truncate_after(1)
+    re1 = OpLog(tmp_path / "p.log")
+    assert re1.epoch == 3
+    assert [r["lsn"] for r in re1.records] == [1]
+
+
+def test_encode_decode_bytes_roundtrip():
+    v = [pk(1), [pk(2), 7], "s", None, 1.5]
+    assert decode_value(encode_value(v)) == v
+
+
+# --- ship / ack / apply -----------------------------------------------------
+
+
+def test_write_ships_to_successor_log_only(tmp_path):
+    """An acked write is durable in the successor's LOG but applied to
+    nothing on the successor — application waits for promote."""
+    stores = _pair(tmp_path)
+    try:
+        stores["n0"].save_storage_negotiated(pk(0), pk(1), MIB)
+        s_part = stores["n1"].parts[0]
+        assert s_part.log.last_lsn == 1
+        assert s_part.log.records[0]["op"] == "save_storage_negotiated"
+        # not applied on the successor...
+        assert stores["n1"].parts[0].get_client_negotiated_peers(pk(0)) \
+            == []
+        # ...but applied on the primary
+        assert stores["n0"].get_client_negotiated_peers(pk(0)) == [pk(1)]
+    finally:
+        _close_all(stores)
+
+
+def test_promote_replays_tail_exactly_once(tmp_path):
+    stores = _pair(tmp_path)
+    try:
+        for i in (1, 3, 5):
+            stores["n0"].save_storage_negotiated(pk(1), pk(i + 100), MIB)
+        epoch = stores["n1"].promote(1)
+        assert epoch == 1
+        assert sorted(stores["n1"].parts[1]
+                      .get_client_negotiated_peers(pk(1))) \
+            == sorted([pk(101), pk(103), pk(105)])
+        # replay again: zero records re-applied, zero rows duplicated
+        assert stores["n1"].parts[1].replay() == 0
+        assert len(stores["n1"].parts[1]
+                   .get_client_negotiated_peers(pk(1))) == 3
+    finally:
+        _close_all(stores)
+
+
+def test_degraded_when_chain_dark_then_gap_refill(tmp_path):
+    """A dark chain degrades (availability over redundancy, counted),
+    and the returning successor's gap triggers a full tail re-ship."""
+    stores = _pair(tmp_path)
+    down = {"flag": True}
+    real_ship = stores["n0"].parts[0].ship
+
+    def flaky(node, payload):
+        if down["flag"]:
+            raise ConnectionError("successor dark")
+        return real_ship(node, payload)
+
+    stores["n0"].set_topology(ship=flaky)
+    try:
+        from backuwup_tpu.net.serverstore import _REPL_SHIPS
+        stores["n0"].save_storage_negotiated(pk(0), pk(2), MIB)  # degraded
+        assert _REPL_SHIPS.value(outcome="degraded") >= 1
+        assert stores["n1"].parts[0].log.last_lsn == 0
+        down["flag"] = False
+        stores["n0"].parts[0]._ship_down.clear()
+        stores["n0"].save_storage_negotiated(pk(0), pk(4), MIB)
+        # the gap (from_lsn 2 vs empty log) forced a refill from lsn 1
+        assert _REPL_SHIPS.value(outcome="gap_refill") >= 1
+        assert stores["n1"].parts[0].log.last_lsn == 2
+    finally:
+        _close_all(stores)
+
+
+def test_reconciliation_sibling_with_longer_log_wins(tmp_path):
+    """The dead primary acked against n1 only; promoting n2 must merge
+    n1's tail before its epoch bump (the server's _promote_partition
+    pull) or the acked rows die with the primary."""
+    stores = _pair(tmp_path, chain=("n1", "n2"))
+    # make n1 the only successor that ever saw the records
+    stores["n0"].set_topology(successors={i: ["n1"]
+                                          for i in range(PARTS)})
+    try:
+        stores["n0"].save_storage_negotiated(pk(0), pk(2), MIB)
+        stores["n0"].save_storage_negotiated(pk(0), pk(4), MIB)
+        assert stores["n2"].parts[0].log.last_lsn == 0
+        # n0 dies; n2 reconciles from n1 then promotes
+        tail = stores["n1"].log_tail(0, stores["n2"].parts[0].log.last_lsn)
+        stores["n2"].accept_ship({
+            "partition": 0,
+            "epoch": max(tail["epoch"], stores["n2"].parts[0].log.epoch),
+            "from_lsn": stores["n2"].parts[0].log.last_lsn + 1,
+            "records": tail["records"]})
+        stores["n2"].promote(0)
+        assert sorted(stores["n2"].parts[0]
+                      .get_client_negotiated_peers(pk(0))) \
+            == sorted([pk(2), pk(4)])
+    finally:
+        _close_all(stores)
+
+
+# --- fencing ----------------------------------------------------------------
+
+
+def test_zombie_primary_fenced_and_divergent_tail_truncated(tmp_path):
+    """The fencing gate: after a successor promotes, the old primary's
+    commits are refused (its write futures fail ReplicationFenced), its
+    unacked divergent tail is truncated when the new primary ships to
+    it, and no row is ever double-applied."""
+    stores = _pair(tmp_path)
+    try:
+        stores["n0"].save_storage_negotiated(pk(0), pk(2), MIB)
+        # network partitions: n0 keeps running but its ships vanish
+        stores["n0"].set_topology(
+            ship=lambda node, payload: (_ for _ in ()).throw(
+                ConnectionError("partitioned")))
+        stores["n0"].save_storage_negotiated(pk(0), pk(4), MIB)  # degraded
+        assert stores["n0"].parts[0].log.last_lsn == 2  # divergent tail
+        # the successor promotes past it
+        assert stores["n1"].promote(0) == 1
+        # heal the partition: n0's next commit is fenced, nothing applies
+        def ship_back(node, payload):
+            return stores[node].accept_ship(payload)
+        stores["n0"].set_topology(ship=ship_back)
+        with pytest.raises(ReplicationFenced) as ei:
+            stores["n0"].save_storage_negotiated(pk(0), pk(6), MIB)
+        assert ei.value.epoch == 1
+        assert stores["n0"].parts[0].fenced
+        # ...and stays fenced without any ship round-trip
+        with pytest.raises(ReplicationFenced):
+            stores["n0"].register_client(pk(0))
+        # n0 rejoins as successor: the new primary's first ship carries
+        # the higher epoch, truncating n0's divergent unacked tail
+        stores["n1"].set_topology(
+            owners={i: "n1" for i in range(PARTS)},
+            successors={i: ["n0"] for i in range(PARTS)}, ship=ship_back)
+        stores["n1"].save_storage_negotiated(pk(0), pk(8), MIB)
+        n0_part = stores["n0"].parts[0]
+        assert [r["lsn"] for r in n0_part.log.records] == [1, 2]
+        assert n0_part.log.records[-1]["epoch"] == 1
+        assert decode_value(n0_part.log.records[-1]["args"])[1] == pk(8)
+        assert n0_part.log.epoch == 1
+        assert not n0_part.fenced
+        # the truncation forced a rebuild: the zombie's divergent pk(4)
+        # row (applied locally in degraded mode) is gone from sqlite,
+        # and the rebuilt state is exactly the surviving log
+        assert not n0_part.log.dirty
+        assert sorted(n0_part.get_client_negotiated_peers(pk(0))) \
+            == sorted([pk(2), pk(8)])
+        # no double-applied rows: promote n0 and diff
+        stores["n0"].promote(0)
+        assert sorted(n0_part.get_client_negotiated_peers(pk(0))) \
+            == sorted([pk(2), pk(8)])
+    finally:
+        _close_all(stores)
+
+
+def test_stale_epoch_ship_refused_at_intake(tmp_path):
+    stores = _pair(tmp_path)
+    try:
+        stores["n1"].promote(0)
+        resp = stores["n1"].accept_ship({
+            "partition": 0, "epoch": 0, "from_lsn": 1,
+            "records": [{"lsn": 1, "epoch": 0, "op": "register_client",
+                         "args": encode_value([pk(0)]), "ts": 1.0}]})
+        assert resp["fenced"] and resp["epoch"] == 1
+        assert stores["n1"].parts[0].log.last_lsn == 0
+    finally:
+        _close_all(stores)
+
+
+# --- the crash seams: arm -> crash -> reopen clean --------------------------
+
+
+def _reopen(root, nid="n0"):
+    return ReplicatedServerStore(root / nid, nid, PARTS)
+
+
+def test_seam_append_pre_crash_leaves_no_trace(tmp_path, plane):
+    stores = _pair(tmp_path)
+    plane.arm_crash("repl.log.append.pre")
+    with pytest.raises(faults.CrashInjected):
+        stores["n0"].register_client(pk(0))
+    re0 = _reopen(tmp_path)
+    try:
+        assert re0.parts[0].log.last_lsn == 0
+        assert not re0.client_exists(pk(0))
+        assert stores["n1"].parts[0].log.last_lsn == 0
+    finally:
+        re0.close()
+        _close_all(stores)
+
+
+def test_seam_append_post_crash_record_durable_not_applied(tmp_path, plane):
+    """Crash between the log fsync and the ship: the record is durable
+    on the primary only, the caller was NEVER acked, and a reopen does
+    not silently apply it — promote does, exactly once."""
+    stores = _pair(tmp_path)
+    plane.arm_crash("repl.log.append.post")
+    with pytest.raises(faults.CrashInjected):
+        stores["n0"].register_client(pk(0))
+    re0 = _reopen(tmp_path)
+    try:
+        assert re0.parts[0].log.last_lsn == 1
+        assert not re0.client_exists(pk(0))  # reopen never auto-applies
+        assert re0.promote(0) == 1
+        assert re0.client_exists(pk(0))
+        assert re0.parts[0].replay() == 0  # exactly once
+    finally:
+        re0.close()
+        _close_all(stores)
+
+
+def test_seam_ship_acked_crash_rolls_forward_on_next_batch(tmp_path, plane):
+    """Crash after the successor ack, before the sqlite apply: the
+    record out-survives the primary (successor log has it) AND the
+    reopened primary's next write batch rolls the unapplied tail
+    forward in the same transaction."""
+    stores = _pair(tmp_path)
+    plane.arm_crash("repl.ship.acked")
+    with pytest.raises(faults.CrashInjected):
+        stores["n0"].save_storage_negotiated(pk(0), pk(2), MIB)
+    assert stores["n1"].parts[0].log.last_lsn == 1  # acked pre-crash
+    re0 = _reopen(tmp_path)
+    try:
+        assert not re0.get_client_negotiated_peers(pk(0))
+        re0.save_storage_negotiated(pk(0), pk(4), MIB)
+        assert sorted(re0.get_client_negotiated_peers(pk(0))) \
+            == sorted([pk(2), pk(4)])
+        assert re0.parts[0].applied_lsn() == 2
+    finally:
+        re0.close()
+        _close_all(stores)
+
+
+def test_seam_promote_pre_crash_is_retryable(tmp_path, plane):
+    stores = _pair(tmp_path)
+    stores["n0"].register_client(pk(0))
+    plane.arm_crash("repl.promote.pre")
+    with pytest.raises(faults.CrashInjected):
+        stores["n1"].promote(0)
+    assert stores["n1"].parts[0].log.epoch == 0  # bump never committed
+    re1 = _reopen(tmp_path, "n1")
+    try:
+        assert re1.promote(0) == 1
+        assert re1.client_exists(pk(0))
+    finally:
+        re1.close()
+        _close_all(stores)
+
+
+def test_seam_promote_post_crash_replay_already_applied(tmp_path, plane):
+    """Crash after the epoch bump + replay: the reopened node re-runs
+    promote; the second replay applies zero records and rows stay
+    exactly-once (epochs only need monotonicity, so the extra bump is
+    harmless)."""
+    stores = _pair(tmp_path)
+    stores["n0"].save_storage_negotiated(pk(0), pk(2), MIB)
+    plane.arm_crash("repl.promote.post")
+    with pytest.raises(faults.CrashInjected):
+        stores["n1"].promote(0)
+    re1 = _reopen(tmp_path, "n1")
+    try:
+        assert re1.parts[0].log.epoch == 1
+        assert re1.parts[0].replay() == 0  # crash hit AFTER the replay
+        assert re1.promote(0) == 2
+        assert re1.parts[0].get_client_negotiated_peers(pk(0)) == [pk(2)]
+    finally:
+        re1.close()
+        _close_all(stores)
+
+
+def test_seam_successor_intake_crash_keeps_log_loadable(tmp_path, plane):
+    stores = _pair(tmp_path)
+    payload = {"partition": 0, "epoch": 0, "from_lsn": 1,
+               "records": [{"lsn": 1, "epoch": 0, "op": "register_client",
+                            "args": encode_value([pk(0)]), "ts": 1.0}]}
+    plane.arm_crash("repl.log.append.pre")
+    with pytest.raises(faults.CrashInjected):
+        stores["n1"].accept_ship(payload)
+    re1 = _reopen(tmp_path, "n1")
+    try:
+        assert re1.parts[0].log.last_lsn == 0
+        # retry after "restart" lands cleanly
+        assert re1.accept_ship(payload)["acked"]
+        assert re1.parts[0].log.last_lsn == 1
+    finally:
+        re1.close()
+        _close_all(stores)
+
+
+# --- the permakill swarm ----------------------------------------------------
+
+
+@pytest.mark.swarm
+@pytest.mark.timeout(240)
+def test_replication_swarm_permakill(tmp_path, loop):
+    """Tier-1 replication acceptance: 3 nodes, per-node replicated
+    stores, a partition-owning node killed for good mid-run.  Gates:
+    a successor promoted within the probe deadline, matchmaking flow
+    continued after the promotion, and zero durable matchmaking rows
+    lost even though the only node that ever APPLIED those partitions'
+    writes is gone."""
+    spec = builtin_swarms()["replication"]
+    card, summary = loop.run_until_complete(run_swarm(spec, tmp_path))
+    assert card.passed, card.render()
+    gates = {a.name: a.passed for a in card.assertions}
+    for gate in ("federation_no_lost_matchmakings",
+                 "replication_promoted",
+                 "replication_post_promote_flow",
+                 "replication_durability_invariant",
+                 "federation_p99_bounded",
+                 "commits_off_event_loop"):
+        assert gates.get(gate) is True, (gate, card.render())
+    assert summary["nodes"] == 3
+    assert summary["shared_store"] is False
+    assert summary["permakills"] == 1
+    assert summary["promotions"] >= 1
+    assert summary["repl_promote_s"] is not None
+    assert summary["post_promote_matchmakings"] > 0
+    assert summary["negotiated_rows"] >= 2 * summary["total_matchmakings"]
+
+
+@pytest.mark.swarm
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_replication_swarm_soak(tmp_path, loop):
+    spec = builtin_swarms()["replication_soak"]
+    card, summary = loop.run_until_complete(run_swarm(spec, tmp_path))
+    assert card.passed, card.render()
+    assert summary["permakills"] == 1
+    assert summary["negotiated_rows"] >= 2 * summary["total_matchmakings"]
+
+
+# --- kill-9 e2e on the promote path -----------------------------------------
+
+_CHILD = """
+import sys
+from backuwup_tpu.utils import faults
+faults.install(faults.from_env())
+from backuwup_tpu.net.serverstore import ReplicatedServerStore
+s = ReplicatedServerStore(sys.argv[1], node_id="n1", partitions=2)
+s.promote(0)
+print("promoted-clean")  # unreachable when the crash is armed
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(120)
+def test_kill9_during_promote_then_clean_promotion(tmp_path):
+    """A real successor process hard-exits (os._exit(70)) mid-promote;
+    the restarted node promotes cleanly and every acked record is
+    applied exactly once."""
+    # build the successor state: two acked records in the log, nothing
+    # applied (the parent plays the dead primary shipping a tail)
+    seed = ReplicatedServerStore(tmp_path / "n1", "n1", PARTS)
+    resp = seed.accept_ship({
+        "partition": 0, "epoch": 0, "from_lsn": 1,
+        "records": [
+            {"lsn": 1, "epoch": 0, "op": "register_client",
+             "args": encode_value([pk(0)]), "ts": 1.0},
+            {"lsn": 2, "epoch": 0, "op": "save_storage_negotiated",
+             "args": encode_value([pk(0), pk(2), MIB]), "ts": 2.0}]})
+    assert resp["acked"]
+    seed.close()
+    env = dict(os.environ,
+               BKW_FAULTS="crash=repl.promote.post@0,crash_hard=1",
+               PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD, str(tmp_path / "n1")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    out, err = proc.communicate(timeout=90)
+    assert proc.returncode == faults.CRASH_EXIT_CODE, (out, err)
+    assert b"promoted-clean" not in out
+    # restart: promote again, rows exactly once
+    node = ReplicatedServerStore(tmp_path / "n1", "n1", PARTS)
+    try:
+        assert node.parts[0].log.epoch == 1  # the bump survived
+        assert node.parts[0].replay() == 0  # replay ran before the kill
+        epoch = node.promote(0)
+        assert epoch == 2
+        assert node.client_exists(pk(0))
+        assert node.get_client_negotiated_peers(pk(0)) == [pk(2)]
+    finally:
+        node.close()

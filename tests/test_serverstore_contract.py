@@ -1,0 +1,168 @@
+"""ServerStore ABC conformance, run against every implementation.
+
+One parameterized suite over `SqliteServerStore` (single file),
+`PartitionedServerStore` (hash-partitioned files), and
+`ReplicatedServerStore` (partitioned + op-log, standalone topology) —
+the contract a future PostgreSQL twin must slot in behind:
+
+* write futures resolve only AFTER the row is durable (an independent
+  reader over the same files sees it, no flush required);
+* `close()` drains the write-behind queue — every accepted write is
+  committed or loudly failed before close returns — and is idempotent,
+  with the connection left readable for post-stop forensics;
+* fan-out reads (`get_clients_storing_on`, `audit_failing_reporters`)
+  merge across partitions with distinct/sum semantics, and
+  `reclaim_negotiation` retires both directions of an edge wherever
+  the two pubkeys hash;
+* group commits happen off the caller's thread (`commit_threads`).
+
+Pubkeys are built so `i` lands on partition ``i % partitions`` (8-byte
+big-endian prefix), letting every cross-partition case pick its keys
+deliberately.
+"""
+
+import threading
+
+import pytest
+
+from backuwup_tpu.net.serverstore import (PartitionedServerStore,
+                                          ReplicatedServerStore,
+                                          ServerStore, SqliteServerStore)
+
+pytestmark = pytest.mark.federation
+
+PARTS = 4
+MIB = 1024 * 1024
+
+
+def pk(i: int) -> bytes:
+    return i.to_bytes(8, "big") + bytes(24)
+
+
+def _mk(kind, root):
+    if kind == "sqlite":
+        return SqliteServerStore(str(root / "s.db"))
+    if kind == "partitioned":
+        return PartitionedServerStore(root / "p", partitions=PARTS)
+    return ReplicatedServerStore(root / "r", node_id="n0",
+                                 partitions=PARTS)
+
+
+@pytest.fixture(params=["sqlite", "partitioned", "replicated"])
+def kind(request):
+    return request.param
+
+
+@pytest.fixture
+def store(kind, tmp_path):
+    s = _mk(kind, tmp_path)
+    yield s
+    s.close()
+
+
+def test_implements_the_abc(store):
+    assert isinstance(store, ServerStore)
+    assert store.schema_version() >= 1
+
+
+def test_register_exists_and_login(store):
+    assert not store.client_exists(pk(1))
+    store.register_client(pk(1))
+    store.client_update_logged_in(pk(1))
+    assert store.client_exists(pk(1))
+    assert not store.client_exists(pk(2))
+
+
+def test_resolved_write_is_durable_before_flush(kind, store, tmp_path):
+    """The durability barrier: when a write call returns (its future
+    resolved), an INDEPENDENT store over the same files must already
+    see the row — no flush(), no close()."""
+    for i in range(PARTS):
+        store.register_client(pk(i))
+        store.save_storage_negotiated(pk(i), pk(i + PARTS), MIB)
+    reader = _mk(kind, tmp_path)
+    try:
+        for i in range(PARTS):
+            assert reader.client_exists(pk(i))
+            assert reader.get_client_negotiated_peers(pk(i)) \
+                == [pk(i + PARTS)]
+    finally:
+        reader.close()
+
+
+def test_snapshot_latest_wins(store):
+    store.save_snapshot(pk(1), b"\x0a" * 32)
+    store.save_snapshot(pk(1), b"\x0b" * 32)
+    assert store.get_latest_client_snapshot(pk(1)) == b"\x0b" * 32
+    assert store.get_latest_client_snapshot(pk(2)) is None
+
+
+def test_fan_out_reads_merge_distinct_across_partitions(store):
+    """`get_clients_storing_on` visits every partition (rows home on
+    the SOURCE pubkey) and must return each storer once, while
+    `get_client_negotiated_peers` stays single-partition."""
+    storers = [pk(1), pk(2), pk(3)]  # three different partitions
+    for s in storers:
+        store.save_storage_negotiated(s, pk(0), MIB)
+    store.save_storage_negotiated(pk(0), pk(5), 2 * MIB)
+    got = store.get_clients_storing_on(pk(0))
+    assert sorted(got) == sorted(storers)
+    assert store.get_client_negotiated_peers(pk(0)) == [pk(5)]
+
+
+def test_reclaim_retires_both_directions(store):
+    """One reclaim call must delete the a->b and b->a edges even though
+    the two rows live in two different partitions."""
+    store.save_storage_negotiated(pk(1), pk(2), MIB)
+    store.save_storage_negotiated(pk(2), pk(1), MIB)
+    assert store.reclaim_negotiation(pk(1), pk(2)) == 2
+    assert store.get_client_negotiated_peers(pk(1)) == []
+    assert store.get_client_negotiated_peers(pk(2)) == []
+    assert store.reclaim_negotiation(pk(1), pk(2)) == 0
+
+
+def test_audit_failing_reporters_sums_partitions(store):
+    """Failing-reporter counts sum across partitions (reports home on
+    the REPORTER pubkey), and a later pass clears a reporter's vote."""
+    for i in (1, 2, 3):
+        store.save_audit_report(pk(i), pk(0), False, "missed proof")
+    assert store.audit_failing_reporters(pk(0), 60.0) == 3
+    store.save_audit_report(pk(2), pk(0), True, "recovered")
+    assert store.audit_failing_reporters(pk(0), 60.0) == 2
+
+
+def test_delete_negotiated_is_exact(store):
+    store.save_storage_negotiated(pk(1), pk(2), MIB)
+    store.save_storage_negotiated(pk(1), pk(3), MIB)
+    store.delete_storage_negotiated(pk(1), pk(2), MIB)
+    assert store.get_client_negotiated_peers(pk(1)) == [pk(3)]
+
+
+def test_commits_run_off_the_caller_thread(store):
+    """Write-behind means the caller thread never holds the sqlite
+    commit — the event-loop-protection invariant the swarm asserts."""
+    store.save_storage_negotiated(pk(1), pk(2), MIB)
+    assert store.commit_threads, "no commit thread recorded"
+    assert threading.get_ident() not in store.commit_threads
+
+
+def test_close_drains_then_reads_and_is_idempotent(kind, store):
+    """Every write accepted before close() is durable after it; close
+    is idempotent; the store stays readable post-close (the server's
+    stop path logs schema_version, swarm forensics count rows)."""
+    n = 32
+    for i in range(n):
+        store.register_client(pk(i))
+    store.close()
+    store.close()
+    for i in range(n):
+        assert store.client_exists(pk(i))
+    assert store.schema_version() >= 1
+
+
+def test_repeated_flush_is_cheap_and_safe(store):
+    store.flush()
+    store.register_client(pk(7))
+    store.flush()
+    store.flush()
+    assert store.client_exists(pk(7))
