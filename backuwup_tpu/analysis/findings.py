@@ -38,6 +38,8 @@ RULE_IDS: Dict[str, str] = {
               " vice versa) with consistent labels",
     "BKW005": "every RequestType/P2PBodyKind member has a live"
               " serve/dispatch arm in net/p2p.py",
+    "BKW006": "sim-covered modules read time only through the"
+              " utils/clock.py seam",
 }
 
 

@@ -1,0 +1,68 @@
+"""BKW006: sim-covered modules read time only through the clock seam.
+
+The simulation plane (``docs/simulation.md``) re-runs the REAL
+matchmaking, retry, peer-stats, and durability-sweep code on virtual
+time.  That promise is only as strong as the absence of stray wall-clock
+reads: one ``time.time()`` inside a covered module and a simulated week
+silently mixes real seconds into virtual ones — no crash, just wrong
+numbers.  So the seam is enforced statically: inside the covered set,
+any direct call to ``time.time`` / ``time.monotonic`` / ``time.sleep``
+/ ``asyncio.sleep`` is a finding, and the deliberate terminals
+(``SystemClock`` itself, the sim's own wall-side instrumentation) carry
+baseline entries with justifications rather than being special-cased
+here — the PR-15 contract: silencing a finding costs a written reason.
+
+Covered modules are a hand-kept list plus the whole ``sim/`` tree.  The
+list grows when a module is put on the virtual clock, and the rule is
+how the list stays honest: porting a module without adding it here
+changes nothing, adding it without porting it turns every stray clock
+read into a finding.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .callgraph import CallGraph
+from .findings import SEV_ERROR, Finding
+
+#: modules whose time reads must route through utils/clock.py — the
+#: exact rel paths plus every file under the prefixes
+CLOCKED_MODULES = (
+    "utils/clock.py",
+    "utils/retry.py",
+    "net/matchmaking.py",
+    "net/peer_stats.py",
+    "obs/invariants.py",
+)
+CLOCKED_PREFIXES = ("sim/",)
+
+#: normalized call forms that read or wait on the real clock
+_WALL_CALLS = ("time.time", "time.monotonic", "time.sleep",
+               "asyncio.sleep")
+
+
+def _covered(rel: str) -> bool:
+    return rel in CLOCKED_MODULES or \
+        any(rel.startswith(p) for p in CLOCKED_PREFIXES)
+
+
+def check_bkw006(graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in sorted(graph.functions.values(), key=lambda f: f.fid):
+        if not _covered(fn.module.rel):
+            continue
+        for cs in fn.calls:
+            if cs.norm not in _WALL_CALLS:
+                continue
+            findings.append(Finding(
+                rule="BKW006", severity=SEV_ERROR,
+                path=fn.module.rel, line=cs.node.lineno,
+                message=(
+                    f"direct wall-clock call '{cs.repr}' in sim-covered"
+                    f" module; route it through the utils/clock.py seam"
+                    f" (clock.now()/monotonic()/await clock.sleep()) so"
+                    f" the simulation plane can substitute virtual"
+                    f" time"),
+                anchor=f"{fn.qualname}->{cs.repr}"))
+    return findings

@@ -17,6 +17,7 @@ from .callgraph import CallGraph, build_graph
 from .findings import RULE_IDS, Finding, LintReport
 from .loader import Package, load_package
 from .rules_async import check_bkw001, check_bkw002
+from .rules_clock import check_bkw006
 from .rules_crash import check_bkw003
 from .rules_drift import check_bkw004, check_bkw005
 
@@ -47,6 +48,7 @@ def _rule_table(cfg: LintConfig) -> Dict[str, Callable[[CallGraph],
         "BKW003": check_bkw003,
         "BKW004": lambda g: check_bkw004(g, cfg.doc_path),
         "BKW005": check_bkw005,
+        "BKW006": check_bkw006,
     }
 
 

@@ -43,12 +43,12 @@ from __future__ import annotations
 import asyncio
 import heapq
 import itertools
-import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from .. import defaults, wire
 from ..obs import metrics as obs_metrics
+from ..utils import clock as clockmod
 
 _QUEUE_DEPTH = obs_metrics.gauge(
     "bkw_matchmaking_queue_depth",
@@ -123,9 +123,11 @@ class ShardedMatchmaker:
 
     def __init__(self, store, connections,
                  expiry_s: Optional[float] = None,
-                 shards: Optional[int] = None):
+                 shards: Optional[int] = None,
+                 clock=None):
         self.db = store
         self.connections = connections
+        self.clock = clockmod.resolve(clock)
         self.expiry_s = (defaults.BACKUP_REQUEST_EXPIRY_S
                          if expiry_s is None else expiry_s)
         n = defaults.MATCHMAKING_SHARDS if not shards else int(shards)
@@ -165,7 +167,7 @@ class ShardedMatchmaker:
         legacy global FIFO ever did (measured: it halves the match rate
         under uniform load).  The shard lock covers only the pop
         itself."""
-        now = time.time()
+        now = self.clock.now()
         home = self.shard_of(requester).index
         n = len(self.shards)
         for i in range(1, n + 1):
@@ -282,7 +284,7 @@ class ShardedMatchmaker:
             shard = self.shard_of(me)
             async with shard.lock:
                 shard.add(next(self._seq), me, remaining,
-                          time.time() + self.expiry_s)
+                          self.clock.now() + self.expiry_s)
         self._refresh_depth()
 
     async def serve_steal(self, requester: bytes, want: int,
@@ -354,7 +356,7 @@ class ShardedMatchmaker:
         call from sync code: every lock-guarded critical section in this
         class is await-free, so no coroutine can be mid-mutation while
         sync code runs on the loop."""
-        now = time.time()
+        now = self.clock.now()
         for shard in self.shards:
             shard.reap(now)
         return self._refresh_depth()

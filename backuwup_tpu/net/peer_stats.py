@@ -22,7 +22,6 @@ gate correctness (a slow peer still holds real shards).
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass, replace
 from typing import Dict, List, Optional
 
@@ -30,6 +29,7 @@ from .. import defaults
 from ..obs import journal as obs_journal
 from ..obs import metrics as obs_metrics
 from ..store import PeerStatsRow
+from ..utils import clock as clockmod
 
 _THROUGHPUT = obs_metrics.gauge(
     "bkw_peer_throughput_bytes_per_second",
@@ -86,9 +86,11 @@ class PeerStats:
     tests and the repair path may observe from other threads.
     """
 
-    def __init__(self, store=None, alpha: Optional[float] = None):
+    def __init__(self, store=None, alpha: Optional[float] = None,
+                 clock=None):
         self.store = store
         self.alpha = defaults.PEER_STATS_ALPHA if alpha is None else alpha
+        self.clock = clockmod.resolve(clock)
         self._lock = threading.Lock()
         self._est: Dict[bytes, PeerEstimate] = {}
         self._demoted: set = set()
@@ -121,7 +123,7 @@ class PeerStats:
         peer's estimators; returns the updated estimate."""
         peer = bytes(result.peer_id)
         label = peer_label(peer)
-        now = time.time() if now is None else now
+        now = self.clock.now() if now is None else now
         with self._lock:
             est = self._est.get(peer, PeerEstimate(peer=peer))
             first = est.samples == 0

@@ -125,6 +125,10 @@ CREATE TABLE IF NOT EXISTS peer_backups (
     size_negotiated INTEGER NOT NULL,
     timestamp REAL NOT NULL
 );
+CREATE INDEX IF NOT EXISTS peer_backups_by_source
+    ON peer_backups (source, destination);
+CREATE INDEX IF NOT EXISTS peer_backups_by_destination
+    ON peer_backups (destination, source);
 CREATE TABLE IF NOT EXISTS snapshots (
     client_pubkey BLOB NOT NULL,
     snapshot_hash BLOB NOT NULL,

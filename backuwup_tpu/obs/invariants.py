@@ -38,13 +38,13 @@ Stdlib-only, like the rest of the obs core.
 from __future__ import annotations
 
 import asyncio
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from .. import defaults
 from . import journal as obs_journal
 from . import metrics as obs_metrics
+from ..utils import clock as clockmod
 
 #: Health taxonomy, worst-first when comparing: every fact is either
 #: fine, a shrinking safety margin, or a broken promise.
@@ -82,7 +82,8 @@ _G_STATUS = obs_metrics.gauge(
     "Durability health: 0 ok, 1 degraded, 2 violated", _LABELS)
 _C_VIOLATION_S = obs_metrics.counter(
     "bkw_durability_violation_seconds_total",
-    "Wall seconds spent with a durability invariant violated", _LABELS)
+    "Monotonic-clock seconds spent with a durability invariant violated",
+    _LABELS)
 _C_SWEEPS = obs_metrics.counter(
     "bkw_durability_sweeps_total", "Invariant monitor sweeps", _LABELS)
 
@@ -169,17 +170,26 @@ class InvariantMonitor:
     ``ClientApp``.
     """
 
-    def __init__(self, store, index=None, client: str = "main"):
+    def __init__(self, store, index=None, client: str = "main",
+                 clock=None):
         self.store = store
         self.index = index
         self.client = client
+        self.clock = clockmod.resolve(clock)
         self.last_report: Optional[InvariantReport] = None
-        self._last_now: Optional[float] = None
+        self._last_mono: Optional[float] = None
 
     # --- the sweep ---------------------------------------------------------
 
     def sweep(self, now: Optional[float] = None) -> InvariantReport:
-        now = time.time() if now is None else now
+        # ``now`` is wall-compatible (judged against persisted last_seen/
+        # sent_at timestamps); the violation-seconds accrual interval is
+        # measured on the monotonic clock so an NTP step can neither
+        # inflate nor hide time-at-risk.  Callers that pin ``now`` (tests,
+        # the sim) get it used for both — explicit virtual time IS the
+        # monotonic axis there.
+        mono = self.clock.monotonic() if now is None else now
+        now = self.clock.now() if now is None else now
         rep = InvariantReport(now=now)
         rows = self.store.all_placements()
         lost = lost_peers(self.store, now)
@@ -268,10 +278,10 @@ class InvariantMonitor:
                 f"stalest audit {rep.audit_coverage_age_s:.0f}s old"
                 f" (> {defaults.DURABILITY_AUDIT_MAX_AGE_S:.0f}s)")
 
-        self._publish(rep, now)
+        self._publish(rep, mono)
         return rep
 
-    def _publish(self, rep: InvariantReport, now: float) -> None:
+    def _publish(self, rep: InvariantReport, mono: float) -> None:
         c = self.client
         _G_STRIPES.set(rep.stripes_total, client=c)
         _G_DEGRADED.set(rep.stripes_degraded, client=c)
@@ -285,9 +295,10 @@ class InvariantMonitor:
         # violation time accrues over the interval the PREVIOUS sweep
         # proved violated — the first bad sweep starts the clock
         prev = self.last_report
-        if prev is not None and self._last_now is not None \
-                and prev.status == STATUS_VIOLATED and now > self._last_now:
-            _C_VIOLATION_S.inc(now - self._last_now, client=c)
+        if prev is not None and self._last_mono is not None \
+                and prev.status == STATUS_VIOLATED \
+                and mono > self._last_mono:
+            _C_VIOLATION_S.inc(mono - self._last_mono, client=c)
         if prev is None or prev.status != rep.status:
             obs_journal.emit("durability", client=c, status=rep.status,
                              stripes_degraded=rep.stripes_degraded,
@@ -295,7 +306,7 @@ class InvariantMonitor:
                              unrestorable=rep.packfiles_unrestorable,
                              repair_debt_bytes=rep.repair_debt_bytes)
         self.last_report = rep
-        self._last_now = now
+        self._last_mono = mono
 
     # --- background cadence ------------------------------------------------
 
@@ -324,7 +335,7 @@ class InvariantMonitor:
                     obs_journal.emit("durability_sweep_error",
                                      client=self.client,
                                      error=repr(e)[:200])
-            await asyncio.sleep(interval)
+            await self.clock.sleep(interval)
 
 
 def summary_from_registry() -> dict:

@@ -124,11 +124,17 @@ class _Family:
         self._series: Dict[Tuple[str, ...], object] = {}
 
     def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
-        if set(labels) != set(self.labelnames):
+        # hot path (every inc/observe): every declared label present and
+        # no extras — checked without building throwaway sets
+        try:
+            key = tuple(str(labels[ln]) for ln in self.labelnames)
+        except KeyError:
+            key = None
+        if key is None or len(labels) != len(self.labelnames):
             raise MetricError(
                 f"{self.name} expects labels {self.labelnames},"
                 f" got {tuple(sorted(labels))}")
-        return tuple(str(labels[ln]) for ln in self.labelnames)
+        return key
 
     def _label_str(self, key: Tuple[str, ...],
                    extra: Optional[Tuple[str, str]] = None) -> str:

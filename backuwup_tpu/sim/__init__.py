@@ -1,0 +1,21 @@
+"""Virtual-clock simulation plane (docs/simulation.md).
+
+A deterministic discrete-event harness that runs the REAL coordination
+code — ShardedMatchmaker, SqliteServerStore, PeerStats, retry policies,
+the durability sweep — on simulated time, with lightweight model
+clients standing in for the engine/crypto/bytes.  A simulated week of
+10⁵–10⁶ client churn executes in tier-1 minutes (``bkw_sim_*`` metrics
+record the compression ratio).
+"""
+
+from .clock import SimClock
+from .driver import SimDriver
+from .model_client import ModelClient, SimParams, SimWorld, client_id
+from .scenarios import (BUILTINS, builtin_sims, card_json, make_scenario,
+                        run_scenario_async, run_sim)
+
+__all__ = [
+    "SimClock", "SimDriver", "ModelClient", "SimParams", "SimWorld",
+    "client_id", "BUILTINS", "builtin_sims", "card_json",
+    "make_scenario", "run_scenario_async", "run_sim",
+]

@@ -1,0 +1,108 @@
+"""SimClock: the virtual-time implementation of the clock seam.
+
+Implements the same three-method contract as
+:class:`backuwup_tpu.utils.clock.SystemClock` — ``now()``,
+``monotonic()``, ``await sleep()`` — plus the deadline heap the driver
+schedules against.  Time never advances on its own: it jumps to the
+next scheduled deadline when :class:`~backuwup_tpu.sim.driver.SimDriver`
+pops it, so a simulated week costs exactly as much wall time as the
+event handlers themselves.
+
+Two scheduling surfaces share one heap:
+
+* ``call_at`` / ``call_later`` — the driver's event API: a plain (or
+  async) callable fired when virtual time reaches the deadline.  Ties
+  break by submission order (a monotonic seq), so runs are replayable.
+* ``sleep(delay)`` — the seam API: parks the *calling task* on the heap
+  via a future the wakeup event resolves.  ``blocked`` counts tasks
+  parked here, which is how the driver knows the loop has quiesced and
+  it is safe to jump time forward.
+
+``now == monotonic`` by construction: virtual time only moves forward,
+so the interval clock and the timestamp clock are the same axis (the
+real-time split exists to survive NTP steps, which the sim does not
+model).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+from typing import Callable, List, Optional, Tuple
+
+
+class SimClock:
+    """Heap-backed virtual clock satisfying the ``utils.clock`` seam."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+        self._heap: List[Tuple[float, int, Callable, tuple]] = []
+        self._seq = itertools.count()
+        #: tasks currently parked inside :meth:`sleep` — the driver's
+        #: quiescence signal
+        self.blocked = 0
+
+    # --- the seam contract --------------------------------------------------
+
+    def now(self) -> float:
+        return self._now
+
+    def monotonic(self) -> float:
+        return self._now
+
+    async def sleep(self, delay: float) -> None:
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self.call_later(delay, self._wake, fut)
+        # ``blocked`` must drop the moment the wake event FIRES (inside
+        # :meth:`_wake`), not when this task resumes: the driver checks
+        # ``active <= blocked`` between firing an event and yielding,
+        # and a woken-but-not-yet-resumed sleeper still counted as
+        # parked would let it advance time right past the resumption.
+        self.blocked += 1
+        try:
+            await fut
+        except BaseException:
+            if not (fut.done() and not fut.cancelled()):
+                # cancelled while parked: _wake never ran (and when its
+                # stale heap event eventually fires it will skip the
+                # done future), so retire the slot here
+                self.blocked -= 1
+            raise
+
+    def _wake(self, fut) -> None:
+        if not fut.done():  # the sleeper may have been cancelled
+            fut.set_result(None)
+            self.blocked -= 1
+
+    # --- the deadline heap --------------------------------------------------
+
+    def call_at(self, when: float, fn: Callable, *args) -> None:
+        """Schedule ``fn(*args)`` for virtual time ``when`` (clamped to
+        now — the past is not addressable).  ``fn`` may be sync or a
+        coroutine function; the driver awaits coroutines inline."""
+        when = self._now if when < self._now else float(when)
+        heapq.heappush(self._heap, (when, next(self._seq), fn, args))
+
+    def call_later(self, delay: float, fn: Callable, *args) -> None:
+        self.call_at(self._now + max(0.0, float(delay)), fn, *args)
+
+    def next_deadline(self) -> Optional[float]:
+        return self._heap[0][0] if self._heap else None
+
+    def pop_event(self) -> Tuple[Callable, tuple]:
+        """Advance to — and return — the earliest event.  Driver-only."""
+        when, _seq, fn, args = heapq.heappop(self._heap)
+        if when > self._now:
+            self._now = when
+        return fn, args
+
+    def advance_to(self, when: float) -> None:
+        """Jump to ``when`` without firing anything (the driver's final
+        hop to the horizon after the heap runs dry)."""
+        if when > self._now:
+            self._now = float(when)
+
+    def pending(self) -> int:
+        return len(self._heap)

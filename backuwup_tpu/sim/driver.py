@@ -1,0 +1,116 @@
+"""SimDriver: single-threaded discrete-event scheduler over a SimClock.
+
+The driver owns the run loop that makes time compression possible: pop
+the earliest deadline off the clock's heap, jump virtual time to it,
+run the handler to completion, repeat.  Wall time is spent only inside
+handlers — the simulated week between two events costs nothing.
+
+Determinism contract:
+
+* every event fires in (deadline, submission-seq) order — no wall
+  clock, no thread scheduling, no hash randomization in the loop;
+* an async handler is awaited to completion *inline*, so its store
+  writes and notify fan-out land before the next event fires.  Handlers
+  must therefore never ``await clock.sleep(...)`` themselves — anything
+  that sleeps belongs in a :meth:`spawn`-ed task;
+* spawned tasks (retry loops, sweep cadences — the real production
+  coroutines) run between events: the driver yields to the asyncio
+  loop until every live task is parked in ``SimClock.sleep`` before it
+  advances time.  A task blocked on anything *else* (a real socket, a
+  real sleep) would stall the run, so quiescence is bounded and the
+  driver raises instead of spinning — keeping the determinism promise
+  honest rather than silently racing.
+
+``bkw_sim_*`` metrics are flushed by the scenario layer after the run
+(one registry write per family, not one per event) so metric plumbing
+never shows up in the events/s budget.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+from .clock import SimClock
+
+#: cooperative-yield budget per quiescence check; a well-formed model
+#: settles in a handful of passes, so hitting this means a spawned task
+#: is blocked outside the clock seam
+_QUIESCE_LIMIT = 10_000
+
+
+class SimDriver:
+    """Event loop for one simulation run."""
+
+    def __init__(self, clock: Optional[SimClock] = None):
+        self.clock = clock if clock is not None else SimClock()
+        self.events = 0
+        self._active = 0  # spawned tasks not yet completed
+        self._tasks: List[asyncio.Task] = []
+        self._failures: List[BaseException] = []
+
+    # --- spawned production coroutines --------------------------------------
+
+    def spawn(self, coro) -> asyncio.Task:
+        """Run a coroutine (a real retry loop, a sweep cadence) alongside
+        the event stream; it advances whenever the tasks it sleeps on the
+        virtual clock come due."""
+        task = asyncio.ensure_future(coro)
+        self._active += 1
+        task.add_done_callback(self._on_done)
+        self._tasks.append(task)
+        return task
+
+    def _on_done(self, task: asyncio.Task) -> None:
+        self._active -= 1
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            self._failures.append(exc)
+
+    async def _quiesce(self) -> None:
+        for _ in range(_QUIESCE_LIMIT):
+            if self._failures:
+                raise self._failures[0]
+            if self._active <= self.clock.blocked:
+                return
+            await asyncio.sleep(0)
+        raise RuntimeError(
+            "sim did not quiesce: a spawned task is blocked on something"
+            " other than SimClock.sleep — the driver cannot advance"
+            " virtual time past it")
+
+    # --- the run loop -------------------------------------------------------
+
+    async def run(self, until: float) -> int:
+        """Fire events in deadline order until virtual time would pass
+        ``until`` (then jump to it); returns events fired this call."""
+        fired = 0
+        clock = self.clock
+        while True:
+            await self._quiesce()
+            deadline = clock.next_deadline()
+            if deadline is None or deadline > until:
+                clock.advance_to(until)
+                await self._quiesce()
+                break
+            fn, args = clock.pop_event()
+            self.events += 1
+            fired += 1
+            res = fn(*args)
+            if res is not None and asyncio.iscoroutine(res):
+                await res
+        return fired
+
+    async def shutdown(self) -> None:
+        """Cancel still-running spawned tasks (infinite cadences like
+        ``InvariantMonitor.run``) so the surrounding loop can close."""
+        for task in self._tasks:
+            if not task.done():
+                task.cancel()
+        for task in self._tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass

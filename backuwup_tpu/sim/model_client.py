@@ -1,0 +1,540 @@
+"""Model clients: seeded state machines over the real wire types.
+
+A :class:`ModelClient` is what is left of a backup client when the
+engine, the crypto, and the bytes are deleted: the *protocol* state
+machine — request storage, receive :class:`~backuwup_tpu.wire.\
+BackupMatched` grants, complete transfers after ``size / bandwidth``
+virtual seconds, audit holders, report failures, repair lost bytes.
+Everything between a request and a grant is the REAL coordination
+plane: :class:`~backuwup_tpu.net.matchmaking.ShardedMatchmaker` over a
+direct-commit :class:`~backuwup_tpu.net.serverstore.SqliteServerStore`,
+both running on the :class:`~backuwup_tpu.sim.clock.SimClock` — the sim
+contributes populations and physics, never a matchmaking
+reimplementation.
+
+Durability accounting is world-truth, not client-belief: a piece
+becomes *lost* the virtual instant its holder dies or drops it (the
+owner only finds out at its next detection window), and
+``violation_client_seconds`` integrates the number of clients holding
+any lost byte — the population-scale analogue of
+``bkw_durability_violation_seconds_total``.  ``repair_debt_bytes`` is
+the same ledger summed in bytes; scenario gates measure how fast a
+failure's debt spike drains back to ~zero.
+
+Determinism: one ``random.Random(seed)`` drawn in event order, ids from
+``blake2b``, no wall-clock reads (BKW006 covers this package) — the
+same seed replays byte-identically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import random
+from collections import namedtuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import defaults, wire
+from ..net.matchmaking import ShardedMatchmaker
+from ..net.peer_stats import PeerStats
+from ..net.serverstore import SqliteServerStore
+from ..utils import retry
+
+#: states a ModelClient moves through (population gauge labels)
+S_OFFLINE = "offline"   # not yet arrived, or temporarily dark
+S_IDLE = "idle"         # online, nothing pending
+S_REQUESTING = "requesting"  # bytes awaiting grant or transfer
+S_STEADY = "steady"     # all pieces placed
+S_DEAD = "dead"         # permanent departure
+
+_ONLINE = (S_IDLE, S_REQUESTING, S_STEADY)
+
+#: TransferResult-shaped record for PeerStats.observe
+_Transfer = namedtuple("_Transfer", "peer_id size ok wait_s send_s")
+
+
+def client_id(index: int) -> bytes:
+    """Deterministic 32-byte client id (wire.CLIENT_ID_LEN)."""
+    return hashlib.blake2b(b"bkw-sim-client:%d" % index,
+                           digest_size=32).digest()
+
+
+@dataclass(frozen=True)
+class SimParams:
+    """Knobs for one simulated population; scenarios freeze these."""
+
+    clients: int
+    sim_seconds: float
+    seed: int = 0
+    regions: int = 8
+    arrival_span_s: float = 86_400.0   # arrivals spread over this window
+    backup_interval_s: float = 3 * 86_400.0
+    last_cycle_before_s: float = 86_400.0  # no new cycles this close to end
+    audit_interval_s: float = 2 * 86_400.0
+    detect_span_s: float = 12 * 3600.0  # loss noticed within this window
+    expiry_s: float = defaults.BACKUP_REQUEST_EXPIRY_S
+    shards: int = 2
+    size_min_b: int = 32 << 20
+    size_max_b: int = 1 << 30
+    bw_min_bps: float = 2e6
+    bw_max_bps: float = 32e6
+    notify_latency_s: float = 0.05
+    freeloader_rate: float = 0.0
+    flapper_rate: float = 0.01
+    flap_span_s: float = 3600.0
+    background_death_rate: float = 0.0  # fraction dying over the horizon
+    fail_at_s: Optional[float] = None
+    fail_fraction: float = 0.0
+    fail_kind: str = "region"  # or "random"
+    pass_report_rate: float = 0.02  # audit passes recorded to the store
+    peer_stats_stride: int = 997  # clients feeding the shared PeerStats
+
+
+class ModelClient:
+    """One simulated client; all behavior runs as clock events."""
+
+    __slots__ = ("world", "idx", "cid", "region", "state", "bw_bps",
+                 "pieces", "pending", "lost_bytes", "freeloader",
+                 "request_started", "demand_bytes", "placed_bytes",
+                 "timer", "next_pid", "cycles")
+
+    def __init__(self, world: "SimWorld", idx: int):
+        self.world = world
+        self.idx = idx
+        self.cid = client_id(idx)
+        self.region = idx % world.params.regions
+        self.state = S_OFFLINE
+        rng = world.rng
+        p = world.params
+        self.bw_bps = _log_uniform(rng, p.bw_min_bps, p.bw_max_bps)
+        #: pid -> [size, holder_cid, dropped] (dropped: holder kept the
+        #: negotiation but not the data — a freeloader placement)
+        self.pieces: Dict[int, list] = {}
+        self.pending = 0          # bytes granted-nor-placed yet
+        self.lost_bytes = 0       # bytes with no live copy (world truth)
+        self.freeloader = rng.random() < p.freeloader_rate
+        self.request_started: Optional[float] = None
+        self.demand_bytes = 0
+        self.placed_bytes = 0
+        self.timer = retry.RetryTimer(retry.STORAGE_REQUEST,
+                                      rand=rng.random, clock=world.clock)
+        self.next_pid = 0
+        self.cycles = 0
+
+    # --- lifecycle events ---------------------------------------------------
+
+    def arrive(self) -> None:
+        if self.state != S_OFFLINE:
+            return
+        self.state = S_IDLE
+        w = self.world
+        w.store.register_client(self.cid)
+        w.clock.call_later(w.rng.random() * 60.0, self.start_backup)
+        w.clock.call_later(
+            w.params.audit_interval_s * (0.5 + w.rng.random()),
+            self.audit_tick)
+
+    def die(self) -> None:
+        """Permanent departure: held data becomes lost for its owners."""
+        if self.state == S_DEAD:
+            return
+        self.state = S_DEAD
+        self.world.on_death(self)
+
+    def go_dark(self, span_s: float) -> None:
+        """Temporary offline window (exercises the offline-drop and
+        failed-push paths of the real matchmaker)."""
+        if self.state in (S_DEAD, S_OFFLINE):
+            return
+        prev = self.state
+        self.state = S_OFFLINE
+        self.world.clock.call_later(span_s, self._return_online, prev)
+
+    def _return_online(self, prev: str) -> None:
+        if self.state != S_OFFLINE:
+            return
+        if self.pending > 0:
+            # demand accumulated while dark (e.g. a loss noticed just
+            # before the flap): pick the request loop back up
+            self.state = S_REQUESTING
+            self.world.clock.call_later(1.0, self._retry_check)
+        else:
+            self.state = prev
+
+    # --- the backup cycle ---------------------------------------------------
+
+    def start_backup(self) -> None:
+        if self.state not in (S_IDLE, S_STEADY, S_REQUESTING):
+            return
+        w = self.world
+        p = w.params
+        size = int(_log_uniform(w.rng, p.size_min_b, p.size_max_b))
+        self.cycles += 1
+        self._add_demand(size)
+        nxt = w.clock.now() + p.backup_interval_s * (0.9 + 0.2 * w.rng.random())
+        if nxt < p.sim_seconds - p.last_cycle_before_s:
+            w.clock.call_at(nxt, self.start_backup)
+
+    def _add_demand(self, size: int) -> None:
+        """New bytes to place (growth or repair); triggers a request."""
+        w = self.world
+        if self.pending == 0 and self.request_started is None:
+            self.request_started = w.clock.now()
+        self.pending += size
+        self.demand_bytes += size
+        w.demand_bytes += size
+        if self.state in _ONLINE:
+            self.state = S_REQUESTING
+            w.clock.call_at(w.clock.now(), self._request, size)
+
+    async def _request(self, amount: int) -> None:
+        """Ask the REAL matchmaker; the unmatched remainder queues on its
+        deadline heap and the retry check below re-asks after expiry."""
+        if self.state != S_REQUESTING or self.pending <= 0:
+            return
+        w = self.world
+        amount = min(amount, self.pending)
+        w.requests += 1
+        await w.matchmaker.fulfill(self.cid, amount, min_peers=1)
+        self.timer.fire()
+        w.clock.call_later(w.params.expiry_s * (1.05 + 0.1 * w.rng.random()),
+                           self._retry_check)
+
+    def _retry_check(self) -> None:
+        if self.state != S_REQUESTING or self.pending <= 0:
+            return
+        w = self.world
+        w.retries += 1
+        w.clock.call_at(w.clock.now(), self._request, self.pending)
+
+    # --- grants and transfers ----------------------------------------------
+
+    def on_push(self, msg) -> None:
+        """A server push delivered over the (simulated) WebSocket."""
+        if isinstance(msg, wire.BackupMatched) and self.state in _ONLINE:
+            self._on_grant(bytes(msg.destination_id),
+                           int(msg.storage_available))
+
+    def _on_grant(self, dest: bytes, available: int) -> None:
+        amt = min(self.pending, available)
+        if amt <= 0:
+            return  # stale grant for an already-satisfied request
+        self.pending -= amt
+        w = self.world
+        w.granted_bytes += amt
+        send_s = amt / self.bw_bps
+        w.clock.call_later(send_s + w.params.notify_latency_s,
+                           self._transfer_done, dest, amt, send_s)
+
+    def _transfer_done(self, dest: bytes, amt: int, send_s: float) -> None:
+        if self.state == S_DEAD:
+            return
+        w = self.world
+        holder = w.by_cid.get(dest)
+        ok = holder is not None and holder.state != S_DEAD
+        if self.idx % w.params.peer_stats_stride == 0:
+            w.peer_stats.observe(_Transfer(
+                peer_id=dest, size=amt, ok=ok, wait_s=0.0, send_s=send_s))
+        if not ok:
+            # the peer vanished mid-transfer: the bytes still need a home
+            self.pending += amt
+            if self.state in _ONLINE:
+                self.state = S_REQUESTING
+                if self.request_started is None:
+                    self.request_started = w.clock.now()
+            w.failed_transfers += 1
+            w.clock.call_later(w.params.expiry_s * w.rng.random(),
+                               self._retry_check)
+            return
+        w.transfers += 1
+        self.placed_bytes += amt
+        w.placed_bytes += amt
+        healed = min(amt, self.lost_bytes)
+        if healed:
+            w.on_healed(self, healed)
+        pid = self.next_pid
+        self.next_pid += 1
+        dropped = holder.freeloader
+        self.pieces[pid] = [amt, dest, dropped]
+        w.held.setdefault(dest, set()).add((self.idx, pid))
+        if dropped:
+            # the holder ack'd and will pass negotiation checks, but the
+            # data is gone the moment it lands — world-truth loss now,
+            # owner discovery at the next audit over this piece
+            w.on_lost(self, amt)
+        if self.pending <= 0:
+            self.pending = 0
+            self.state = S_STEADY
+            self.timer.reset()
+            if self.request_started is not None:
+                w.match_waits.append(w.clock.now() - self.request_started)
+                self.request_started = None
+
+    # --- audits and repair --------------------------------------------------
+
+    def audit_tick(self) -> None:
+        if self.state == S_DEAD:
+            return
+        w = self.world
+        if self.state in _ONLINE and self.pieces:
+            pid, piece = self._audit_target()
+            size, holder_cid, dropped = piece
+            holder = w.by_cid.get(holder_cid)
+            failed = dropped or holder is None or holder.state == S_DEAD
+            if failed:
+                w.audit_failures += 1
+                w.store.save_audit_report(self.cid, holder_cid, False,
+                                          "sim: holder lost data")
+                self._start_repair(pid)
+            else:
+                w.audit_passes += 1
+                if w.rng.random() < w.params.pass_report_rate:
+                    w.store.save_audit_report(self.cid, holder_cid, True,
+                                              "sim: ok")
+        w.clock.call_later(
+            w.params.audit_interval_s * (0.8 + 0.4 * w.rng.random()),
+            self.audit_tick)
+
+    def _audit_target(self) -> Tuple[int, list]:
+        """Dropped/dead-holder pieces first (deterministic scan), else a
+        seeded pick — models an auditor that cycles all its holders."""
+        w = self.world
+        for pid in self.pieces:
+            piece = self.pieces[pid]
+            holder = w.by_cid.get(piece[1])
+            if piece[2] or holder is None or holder.state == S_DEAD:
+                return pid, piece
+        pids = list(self.pieces)
+        pid = pids[w.rng.randrange(len(pids))]
+        return pid, self.pieces[pid]
+
+    def notice_loss(self, pid: int) -> None:
+        """The owner's delayed discovery of a dead holder (the audit /
+        dark-deadline path, collapsed to a seeded detection window)."""
+        if self.state == S_DEAD or pid not in self.pieces:
+            return
+        piece = self.pieces[pid]
+        self.world.store.save_audit_report(
+            self.cid, piece[1], False, "sim: holder dead")
+        self._start_repair(pid)
+
+    def _start_repair(self, pid: int) -> None:
+        piece = self.pieces.pop(pid, None)
+        if piece is None:
+            return
+        w = self.world
+        size, holder_cid, _dropped = piece
+        w.held.get(holder_cid, set()).discard((self.idx, pid))
+        w.repairs_started += 1
+        w.store.save_repair_report(self.cid, holder_cid, 1, size, 0)
+        self._add_demand(size)
+
+
+class SimConnections:
+    """The matchmaker's ``Connections`` interface over the population:
+    pushes become clock events delivered after a small latency."""
+
+    def __init__(self, world: "SimWorld"):
+        self.world = world
+
+    def is_online(self, client_id: bytes) -> bool:
+        c = self.world.by_cid.get(bytes(client_id))
+        return c is not None and c.state in _ONLINE
+
+    async def notify(self, client_id: bytes, msg) -> bool:
+        c = self.world.by_cid.get(bytes(client_id))
+        if c is None or c.state not in _ONLINE:
+            return False
+        self.world.clock.call_later(
+            self.world.params.notify_latency_s, c.on_push, msg)
+        return True
+
+
+class SimWorld:
+    """Population + real coordination plane + durability ledger."""
+
+    def __init__(self, clock, params: SimParams):
+        self.clock = clock
+        self.params = params
+        self.rng = random.Random(params.seed)
+        self.store = SqliteServerStore(":memory:", write_behind=False)
+        self.connections = SimConnections(self)
+        self.matchmaker = ShardedMatchmaker(
+            self.store, self.connections, expiry_s=params.expiry_s,
+            shards=params.shards, clock=clock)
+        self.peer_stats = PeerStats(clock=clock)
+        self.clients: List[ModelClient] = []
+        self.by_cid: Dict[bytes, ModelClient] = {}
+        #: holder cid -> {(owner idx, pid)} — the reverse placement index
+        #: that makes holder-death fan-out O(pieces held)
+        self.held: Dict[bytes, Set[Tuple[int, int]]] = {}
+        # demand/supply ledger
+        self.demand_bytes = 0
+        self.granted_bytes = 0
+        self.placed_bytes = 0
+        self.requests = 0
+        self.retries = 0
+        self.transfers = 0
+        self.failed_transfers = 0
+        self.audit_failures = 0
+        self.audit_passes = 0
+        self.repairs_started = 0
+        self.deaths = 0
+        self.match_waits: List[float] = []
+        # durability ledger (world truth, accrued incrementally)
+        self.repair_debt_bytes = 0
+        self.debt_peak_bytes = 0
+        self.violated_clients = 0
+        self.violation_client_seconds = 0.0
+        self._viol_last_t = 0.0
+        # failure-drain tracking (armed by inject_failure)
+        self.fail_time: Optional[float] = None
+        self.drain_s: Optional[float] = None
+        self._drain_floor = 0
+
+    # --- population construction -------------------------------------------
+
+    def populate(self) -> None:
+        """Create the population and schedule arrivals, flaps, and
+        background deaths — all draws in index order for replay."""
+        p = self.params
+        for i in range(p.clients):
+            c = ModelClient(self, i)
+            self.clients.append(c)
+            self.by_cid[c.cid] = c
+            self.clock.call_at(self.rng.random() * p.arrival_span_s,
+                               c.arrive)
+            if self.rng.random() < p.flapper_rate:
+                at = p.arrival_span_s + self.rng.random() * max(
+                    1.0, p.sim_seconds - 2 * p.arrival_span_s)
+                self.clock.call_at(at, c.go_dark, p.flap_span_s)
+            if p.background_death_rate > 0 \
+                    and self.rng.random() < p.background_death_rate:
+                at = p.arrival_span_s + self.rng.random() * max(
+                    1.0, p.sim_seconds - p.arrival_span_s)
+                self.clock.call_at(at, self._kill, c)
+        if p.fail_at_s is not None and p.fail_fraction > 0:
+            self.clock.call_at(p.fail_at_s, self.inject_failure)
+
+    def _kill(self, c: ModelClient) -> None:
+        if c.state != S_DEAD:
+            self.deaths += 1
+            c.die()
+
+    def inject_failure(self) -> None:
+        """The scenario's mass-failure event: a region (correlated) or a
+        seeded random fraction (uncorrelated) departs at one instant."""
+        p = self.params
+        self.fail_time = self.clock.now()
+        if p.fail_kind == "region":
+            doomed_regions = max(1, round(p.regions * p.fail_fraction))
+            doomed = [c for c in self.clients
+                      if c.region < doomed_regions and c.state != S_DEAD]
+        else:
+            doomed = [c for c in self.clients
+                      if c.state != S_DEAD
+                      and self.rng.random() < p.fail_fraction]
+        for c in doomed:
+            self._kill(c)
+        self.debt_peak_bytes = max(self.debt_peak_bytes,
+                                   self.repair_debt_bytes)
+        self._drain_floor = max(1, self.repair_debt_bytes // 20)
+
+    # --- the durability ledger ---------------------------------------------
+
+    def _accrue(self) -> None:
+        now = self.clock.now()
+        if now > self._viol_last_t:
+            self.violation_client_seconds += \
+                self.violated_clients * (now - self._viol_last_t)
+        self._viol_last_t = now
+
+    def on_lost(self, owner: ModelClient, size: int) -> None:
+        self._accrue()
+        if owner.lost_bytes == 0:
+            self.violated_clients += 1
+        owner.lost_bytes += size
+        self.repair_debt_bytes += size
+        self.debt_peak_bytes = max(self.debt_peak_bytes,
+                                   self.repair_debt_bytes)
+
+    def on_healed(self, owner: ModelClient, size: int) -> None:
+        self._accrue()
+        owner.lost_bytes -= size
+        self.repair_debt_bytes -= size
+        if owner.lost_bytes == 0:
+            self.violated_clients -= 1
+        self._check_drained()
+
+    def _check_drained(self) -> None:
+        if self.fail_time is not None and self.drain_s is None \
+                and self.repair_debt_bytes <= self._drain_floor:
+            self.drain_s = self.clock.now() - self.fail_time
+
+    def on_death(self, holder: ModelClient) -> None:
+        """Holder death: every piece it held is lost NOW; each owner
+        notices within its detection window and starts repair.  The
+        dead client's own pending/pieces stop mattering — its queued
+        matchmaking entries are dropped at pop (offline check) and its
+        placements are reclaimed by its (former) peers."""
+        p = self.params
+        if holder.lost_bytes > 0:
+            # a dead owner has no one to restore to: retire its ledger
+            # (mutual-death pairs in a region kill would otherwise pin
+            # repair debt forever)
+            self._accrue()
+            self.violated_clients -= 1
+            self.repair_debt_bytes -= holder.lost_bytes
+            holder.lost_bytes = 0
+            self._check_drained()
+        for owner_idx, pid in sorted(self.held.pop(holder.cid, ())):
+            owner = self.clients[owner_idx]
+            if owner.state == S_DEAD:
+                continue
+            piece = owner.pieces.get(pid)
+            if piece is None or piece[2]:
+                continue  # already counted lost (freeloader drop)
+            piece[2] = True
+            self.on_lost(owner, piece[0])
+            self.clock.call_later(p.detect_span_s * self.rng.random(),
+                                  owner.notice_loss, pid)
+        # peers reclaim the dead client's own placements (the real
+        # reclaim path; sampled — one peer per dead client keeps the
+        # sqlite cost proportional to deaths, not placements)
+        for pid, piece in list(holder.pieces.items())[:1]:
+            self.store.reclaim_negotiation(holder.cid, piece[1])
+
+    def finish(self) -> None:
+        """Final ledger accrual at the horizon."""
+        self._accrue()
+
+    # --- derived facts ------------------------------------------------------
+
+    def match_rate(self) -> float:
+        if self.demand_bytes <= 0:
+            return 1.0
+        return self.placed_bytes / self.demand_bytes
+
+    def state_counts(self) -> Dict[str, int]:
+        counts = {s: 0 for s in
+                  (S_OFFLINE, S_IDLE, S_REQUESTING, S_STEADY, S_DEAD)}
+        for c in self.clients:
+            counts[c.state] += 1
+        return counts
+
+    def wait_quantile(self, q: float) -> float:
+        if not self.match_waits:
+            return 0.0
+        waits = sorted(self.match_waits)
+        i = min(len(waits) - 1, int(q * len(waits)))
+        return waits[i]
+
+    def close(self) -> None:
+        self.store.close()
+
+
+def _log_uniform(rng: random.Random, lo: float, hi: float) -> float:
+    if hi <= lo:
+        return lo
+    return math.exp(rng.uniform(math.log(lo), math.log(hi)))

@@ -24,12 +24,12 @@ test-asserted schedules (the audit ledger) set ``jitter=0``.
 
 from __future__ import annotations
 
-import asyncio
 import random
 from dataclasses import dataclass
 from typing import Callable, Optional, Tuple, Type
 
 from .. import defaults
+from . import clock as clockmod
 from ..obs import journal as obs_journal
 from ..obs import metrics as obs_metrics
 
@@ -79,9 +79,11 @@ class Backoff:
     """
 
     def __init__(self, policy: RetryPolicy,
-                 rand: Optional[Callable[[], float]] = None):
+                 rand: Optional[Callable[[], float]] = None,
+                 clock=None):
         self.policy = policy
         self._rand = rand
+        self.clock = clockmod.resolve(clock)
         self.attempt = 0
 
     def reset(self) -> None:
@@ -101,7 +103,7 @@ class Backoff:
         delay = self.next_delay()
         if delay is None:
             return False
-        await asyncio.sleep(delay)
+        await self.clock.sleep(delay)
         return True
 
 
@@ -116,16 +118,20 @@ class RetryTimer:
     """
 
     def __init__(self, policy: RetryPolicy,
-                 rand: Optional[Callable[[], float]] = None):
+                 rand: Optional[Callable[[], float]] = None,
+                 clock=None):
         self.policy = policy
         self._rand = rand
+        self.clock = clockmod.resolve(clock)
         self.attempt = 0
         self._next_at = 0.0
 
-    def due(self, now: float) -> bool:
+    def due(self, now: Optional[float] = None) -> bool:
+        now = self.clock.now() if now is None else now
         return now >= self._next_at
 
-    def fire(self, now: float) -> None:
+    def fire(self, now: Optional[float] = None) -> None:
+        now = self.clock.now() if now is None else now
         self.attempt += 1
         _record_attempt(self.policy, self.attempt)
         self._next_at = now + self.policy.delay_s(self.attempt, self._rand)
@@ -138,11 +144,13 @@ class RetryTimer:
 async def retry_async(fn, policy: RetryPolicy, *,
                       retry_on: Tuple[Type[BaseException], ...] = (Exception,),
                       rand: Optional[Callable[[], float]] = None,
-                      on_retry: Optional[Callable] = None):
+                      on_retry: Optional[Callable] = None,
+                      clock=None):
     """``await fn()`` with retries per ``policy``; re-raises the last error
     once the attempt budget is spent.  ``on_retry(attempt, exc)`` observes
-    each failure (logging hook)."""
-    backoff = Backoff(policy, rand)
+    each failure (logging hook); ``clock`` routes the backoff sleeps
+    through the clock seam (``utils.clock``) for virtual-time callers."""
+    backoff = Backoff(policy, rand, clock=clock)
     while True:
         try:
             return await fn()

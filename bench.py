@@ -287,6 +287,14 @@ def main() -> None:
     if "replication_lost_rows" in repl:
         record["replication_lost_rows"] = repl["replication_lost_rows"]
         record["repl_promote_s"] = repl.get("repl_promote_s")
+    # config #19 is the virtual-clock simulation plane: surface the
+    # driver throughput and the time-compression ratio at top level so
+    # BENCH_r*.json diffs track whether a simulated week still fits a
+    # tier-1 minute
+    sim = configs.get("19_sim", {})
+    if "sim_time_compression" in sim:
+        record["sim_events_per_s"] = sim["sim_events_per_s"]
+        record["sim_time_compression"] = sim["sim_time_compression"]
     print(json.dumps({
         **record,
         "note": "corpus synthesized on-device (host<->device relay tunnel "

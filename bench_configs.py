@@ -1721,6 +1721,52 @@ def config18_replication(log: Callable) -> Dict:
             "scorecard": repl_card.to_dict()}
 
 
+def config19_sim(log: Callable) -> Dict:
+    """Virtual-clock simulation plane — config #19 (docs/simulation.md).
+
+    Two legs land in one record:
+
+    * **throughput leg** — the tier-1 acceptance builtin
+      (``regionfail``: 10⁵ clients, a simulated week, a quarter of the
+      regions lost on day 2) at full scale, real matchmaking and
+      serverstore on the virtual clock.  Records driver events/s and
+      the time-compression ratio (virtual seconds per wall second).
+      Hard gates: the scenario's own scorecard all green AND
+      compression ≥ ``BENCH_C19_COMPRESSION_GATE`` (default 10⁴× — a
+      simulated week inside about a wall minute).
+    * **determinism leg** — ``flashcrowd`` at 2 000 clients twice with
+      the same seed: the scorecards must be byte-identical
+      (``card_json``), the replay contract triage leans on.
+    """
+    from backuwup_tpu.sim import card_json, run_sim
+
+    clients = int(os.environ.get("BENCH_C19_CLIENTS", "100000"))
+    gate = float(os.environ.get("BENCH_C19_COMPRESSION_GATE", "10000"))
+    card, stats = run_sim("regionfail", clients=clients)
+    d1, _ = run_sim("flashcrowd", clients=2000)
+    d2, _ = run_sim("flashcrowd", clients=2000)
+    deterministic = card_json(d1) == card_json(d2)
+    passed = (card["passed"] and deterministic
+              and stats["time_compression"] >= gate)
+    log(f"config#19 sim: regionfail@{clients} simulated "
+        f"{card['sim_seconds'] / 86400:.0f}d in {stats['wall_s']}s "
+        f"({stats['events_per_s']:.0f} ev/s, "
+        f"{stats['time_compression']:.0f}x compression vs gate "
+        f"{gate:.0f}x) gates={'green' if card['passed'] else 'RED'} "
+        f"determinism={'ok' if deterministic else 'BROKEN'} "
+        f"[{'PASS' if passed else 'FAIL'}]")
+    return {"passed": passed,
+            "sim_events_per_s": stats["events_per_s"],
+            "sim_time_compression": stats["time_compression"],
+            "sim_wall_s": stats["wall_s"],
+            "sim_events": card["events"],
+            "deterministic": deterministic,
+            "match_rate": card["match_rate"],
+            "repair_drain_s": card["repair_drain_s"],
+            "violation_client_seconds": card["violation_client_seconds"],
+            "scorecard": card}
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -1743,7 +1789,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("15_gc", lambda: config15_gc(log)),
             ("16_federation", lambda: config16_federation(log)),
             ("17_tiered", lambda: config17_tiered(log)),
-            ("18_replication", lambda: config18_replication(log))):
+            ("18_replication", lambda: config18_replication(log)),
+            ("19_sim", lambda: config19_sim(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

@@ -27,6 +27,7 @@ from backuwup_tpu.obs import journal as obs_journal  # noqa: E402
 from backuwup_tpu.obs import timeline as obs_timeline  # noqa: E402
 from backuwup_tpu.scenario import (builtin_scenarios, builtin_swarms,  # noqa: E402
                                    run_scenario, run_swarm)
+from backuwup_tpu.sim import builtin_sims, card_json, run_sim  # noqa: E402
 
 
 def main() -> int:
@@ -37,6 +38,8 @@ def main() -> int:
                     help="list built-in scenarios and exit")
     ap.add_argument("--seed", type=int, default=None,
                     help="override the scenario's seed")
+    ap.add_argument("--clients", type=int, default=None,
+                    help="population override for sim scenarios")
     ap.add_argument("--out", default=None,
                     help="write the scorecard JSON here")
     ap.add_argument("--samples", default=None,
@@ -50,12 +53,31 @@ def main() -> int:
 
     scenarios = builtin_scenarios()
     swarms = builtin_swarms()
+    sims = builtin_sims()
     if args.list:
         for name, spec in {**scenarios, **swarms}.items():
             kind = "swarm" if name in swarms else "chaos"
             print(f"{name:12s} {kind:5s} seed={spec.seed:<4d} "
                   f"phases={'/'.join(p.label for p in spec.phases)}")
+        for name, desc in sims.items():
+            print(f"{name:12s} sim   {desc}")
         return 0
+    if args.scenario in sims:
+        # virtual-clock plane: no workdir, no journal — one process, one
+        # event loop, wall-free scorecard (docs/simulation.md)
+        card, stats = run_sim(args.scenario, clients=args.clients,
+                              seed=args.seed)
+        for gate in card["gates"]:
+            mark = "PASS" if gate["passed"] else "FAIL"
+            print(f"[{mark}] {gate['name']}: {gate['detail']}")
+        print(f"simulated {card['sim_seconds'] / 86_400:.1f}d of"
+              f" {card['clients']} clients in {stats['wall_s']}s wall"
+              f" ({stats['events_per_s']} ev/s,"
+              f" {stats['time_compression']}x compression)")
+        if args.out:
+            Path(args.out).write_text(card_json(card) + "\n")
+            print(f"scorecard written to {args.out}")
+        return 0 if card["passed"] else 1
     spec = scenarios.get(args.scenario) or swarms.get(args.scenario)
     if spec is None:
         print(f"unknown scenario {args.scenario!r}; try --list",

@@ -191,6 +191,18 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "replication bench recapture FAILED (see $rpl) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated sim recapture: config #19 alone (host-only virtual
+        # clock: the 1e5-client simulated-week builtin plus the
+        # determinism double-run) — events/s and the time-compression
+        # ratio survive even when the device suite timed out partway
+        simj="$BENCH_OUT_DIR/BENCH_sim_${stamp}.json"
+        if timeout "${BENCH_SIM_TIMEOUT_S:-600}" \
+                env BENCH_ONLY_CONFIG=19_sim BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$simj" 2>>/tmp/tpu_watch.log; then
+            echo "sim bench recaptured to $simj at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "sim bench recapture FAILED (see $simj) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

@@ -91,6 +91,11 @@ def pytest_configure(config):
         "markers", "profile: timing-sensitive profiling tests"
         " (obs/profile.py dev timer); excluded from tier-1 like accel —"
         " set BKW_PROFILE_TESTS=1 to run them")
+    config.addinivalue_line(
+        "markers", "sim: virtual-clock simulation-plane tests"
+        " (backuwup_tpu/sim, docs/simulation.md); the 10^5-client"
+        " simulated-week builtin is tier-1, the 10^6 soak is also"
+        " marked slow")
 
 
 def pytest_collection_modifyitems(config, items):

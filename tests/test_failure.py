@@ -97,27 +97,44 @@ def test_retry_timer_due_fire_reset():
     assert t.due(130.0) and t.attempt == 0
 
 
-def test_retry_async_succeeds_then_exhausts(loop):
-    p = retry.RetryPolicy(base_s=0.001, cap_s=0.01, jitter=0.0,
+def test_retry_async_succeeds_then_exhausts_on_virtual_clock(loop):
+    """retry_async rides the clock seam: real-scale backoff delays
+    elapse in virtual time (the test sleeps zero wall seconds), so the
+    progression can be asserted EXACTLY instead of dwarfing base_s down
+    to milliseconds and hoping the wall clock keeps up."""
+    from backuwup_tpu.sim import SimClock, SimDriver
+    p = retry.RetryPolicy(base_s=2.0, cap_s=8.0, jitter=0.0,
                           max_attempts=3)
+    clock = SimClock()
+    driver = SimDriver(clock)
     calls = {"n": 0}
 
     async def flaky():
         calls["n"] += 1
         if calls["n"] < 3:
             raise OSError("transient")
-        return "ok"
+        return clock.now()
 
-    assert loop.run_until_complete(retry.retry_async(
-        flaky, p, retry_on=(OSError,))) == "ok"
+    async def scenario():
+        task = driver.spawn(retry.retry_async(
+            flaky, p, retry_on=(OSError,), clock=clock))
+        await driver.run(until=60.0)
+        return await task
+
+    done_at = loop.run_until_complete(scenario())
     assert calls["n"] == 3
+    assert done_at == 2.0 + 4.0  # base, then doubled: virtual seconds
 
     async def always_down():
         raise OSError("hard down")
 
+    async def exhaust():
+        driver.spawn(retry.retry_async(
+            always_down, p, retry_on=(OSError,), clock=clock))
+        await driver.run(until=180.0)
+
     with pytest.raises(OSError, match="hard down"):
-        loop.run_until_complete(retry.retry_async(
-            always_down, p, retry_on=(OSError,)))
+        loop.run_until_complete(exhaust())
 
 
 def test_audit_policy_matches_ledger_backoff():

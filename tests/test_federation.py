@@ -31,7 +31,8 @@ from backuwup_tpu import defaults
 from backuwup_tpu.crypto import KeyManager
 from backuwup_tpu.net import client as net_client
 from backuwup_tpu.net.matchmaking import ShardedMatchmaker
-from backuwup_tpu.net.ring import HashRing, partition_of
+from backuwup_tpu.net.ring import (HashRing, partition_key, partition_of,
+                                   successors)
 from backuwup_tpu.net.server import CoordinationServer
 from backuwup_tpu.net.serverstore import (PartitionedServerStore,
                                           SqliteServerStore)
@@ -91,6 +92,31 @@ def test_ring_remove_moves_only_its_own_keys():
         else:
             # a survivor's keys never move on a remove
             assert ring.owner(k) == before[k]
+
+
+def test_ring_successors_disjoint_and_stable_at_every_size():
+    """Replication-chain property sweep over N = 1..64: for every
+    partition the successor chain never contains the owner, has no
+    duplicates, and is exactly min(count, N-1) long; and removing a
+    node OUTSIDE owner+chain leaves both owner and chain untouched
+    (the promote-on-death blast radius is the chain, nothing else)."""
+    parts = range(8)
+    for n in range(1, 65):
+        ring = HashRing([f"node{i}" for i in range(n)])
+        for part in parts:
+            owner = ring.owner(partition_key(part))
+            chain = successors(ring, part, count=3)
+            assert owner not in chain
+            assert len(chain) == len(set(chain)) == min(3, n - 1)
+            involved = {owner, *chain}
+            outsider = next((f"node{i}" for i in range(n)
+                             if f"node{i}" not in involved), None)
+            if outsider is None:
+                continue  # every node is on this partition's chain
+            ring.remove(outsider)
+            assert ring.owner(partition_key(part)) == owner
+            assert successors(ring, part, count=3) == chain
+            ring.add(outsider)  # hash-positioned: exact inverse
 
 
 def test_ring_steal_order_home_last_parity():

@@ -366,6 +366,49 @@ def test_bkw005_exhaustive_dispatch_is_silent(tmp_path):
     assert _lint(root, {"BKW005"}).findings == []
 
 
+# --- BKW006: clock-seam purity in sim-covered modules -----------------------
+
+
+def test_bkw006_flags_wall_clock_in_covered_module(tmp_path):
+    root = _mk_pkg(tmp_path, {
+        "utils/retry.py": ("import time, asyncio\n"
+                           "def due():\n"
+                           "    return time.time()\n"
+                           "async def pause():\n"
+                           "    await asyncio.sleep(1)\n")})
+    report = _lint(root, {"BKW006"})
+    assert {f.anchor for f in report.findings} == {
+        "due->time.time", "pause->asyncio.sleep"}
+    assert all(f.severity == "error" for f in report.findings)
+    assert "utils/clock.py seam" in report.findings[0].message
+
+
+def test_bkw006_sim_tree_is_covered_and_others_are_not(tmp_path):
+    root = _mk_pkg(tmp_path, {
+        "sim/driver.py": ("import time\n"
+                          "def tick():\n"
+                          "    return time.monotonic()\n"),
+        "engine.py": ("import time\n"
+                      "def stamp():\n"
+                      "    return time.time()\n")})
+    report = _lint(root, {"BKW006"})
+    assert {f.path for f in report.findings} == {"sim/driver.py"}
+
+
+def test_bkw006_seam_calls_are_silent(tmp_path):
+    root = _mk_pkg(tmp_path, {
+        "net/peer_stats.py": (
+            "from ..utils import clock as clockmod\n"
+            "class PeerStats:\n"
+            "    def __init__(self, clock=None):\n"
+            "        self.clock = clockmod.resolve(clock)\n"
+            "    def observe(self):\n"
+            "        return self.clock.now()\n"),
+        "utils/clock.py": ("def resolve(c):\n"
+                           "    return c\n")})
+    assert _lint(root, {"BKW006"}).findings == []
+
+
 # --- baseline semantics -----------------------------------------------------
 
 
@@ -513,7 +556,7 @@ def test_unjustified_baseline_entries_reported(tmp_path):
 
 def test_repo_is_lint_clean():
     """The gate: zero unbaselined findings and zero stale baseline
-    entries across all five rules on the real tree."""
+    entries across all six rules on the real tree."""
     report = run_lint(LintConfig.for_repo(REPO))
     assert report.findings == [], \
         "\n".join(f.render() for f in report.findings)
