@@ -11,6 +11,14 @@ the call is routed through the executor seam (``Engine._blocking``,
 the executor are sync functions that are never *called* from the async
 body, so the graph naturally keeps them off the loop's account.
 
+Sync callables are also on the loop's account when they are *scheduled*
+onto it: the first argument of ``loop.call_soon``,
+``loop.call_soon_threadsafe``, or ``Future.add_done_callback`` runs on
+the event-loop thread even though no async body ever calls it.  Each
+resolvable callback becomes an additional BKW001 root (the dataflow
+engine's seal->send wakeup, docs/dataflow.md, is exactly this shape —
+``notify_packfile`` must stay O(set-an-event)).
+
 One finding per (blocking call site, nearest async root) — anchored at
 the blocking site so the key survives refactors of the async caller's
 internals.
@@ -36,6 +44,11 @@ from .findings import SEV_ERROR, SEV_WARNING, Finding
 
 #: exact dotted forms that run their payload off the event loop
 EXECUTOR_SEAM_SUFFIXES = ("._blocking", ".run_in_executor", ".to_thread")
+
+#: loop-scheduling primitives whose callable argument later runs ON the
+#: event-loop thread even though no async body ever calls it directly
+LOOP_CALLBACK_SUFFIXES = (".call_soon", ".call_soon_threadsafe",
+                          ".add_done_callback")
 
 #: pathlib-style attribute calls that hit the disk whoever the receiver
 BLOCKING_ATTRS = ("read_bytes", "write_bytes", "read_text", "write_text")
@@ -76,10 +89,36 @@ def _direct_blocking(fn: FuncInfo) -> List[Tuple[CallSite, str]]:
             for cat in (_blocking_category(cs),) if cat]
 
 
+def _loop_callback_roots(
+        graph: CallGraph) -> List[Tuple[FuncInfo, FuncInfo, CallSite]]:
+    """Every resolvable sync callable handed to a loop-scheduling
+    primitive: (callback fn, scheduling fn, scheduling call site)."""
+    roots: List[Tuple[FuncInfo, FuncInfo, CallSite]] = []
+    seen = set()
+    for fn in sorted(graph.functions.values(), key=lambda f: f.fid):
+        for cs in fn.calls:
+            if not any(cs.norm.endswith(s)
+                       for s in LOOP_CALLBACK_SUFFIXES):
+                continue
+            if not cs.node.args:
+                continue
+            # the callback is positional arg 0 for all three primitives;
+            # resolve it with the same machinery as a call target
+            fake = ast.Call(func=cs.node.args[0], args=[], keywords=[])
+            target = graph._resolve_target(fn, fake)
+            info = graph.functions.get(target) if target else None
+            if info is None or info.is_async or info.fid in seen:
+                continue  # async callbacks are already roots
+            seen.add(info.fid)
+            roots.append((info, fn, cs))
+    return roots
+
+
 def check_bkw001(graph: CallGraph) -> List[Finding]:
     findings: List[Finding] = []
     reported = set()  # (blocking fid, call line-agnostic anchor)
-    for root in sorted(graph.async_functions(), key=lambda f: f.fid):
+
+    def scan_root(root: FuncInfo, how: str) -> None:
         parents = graph.reachable_from(root.fid, skip_call=_is_executor_seam)
         for fid in [root.fid] + sorted(parents):
             holder = graph.functions.get(fid)
@@ -99,10 +138,18 @@ def check_bkw001(graph: CallGraph) -> List[Finding]:
                     path=holder.module.rel, line=cs.node.lineno,
                     message=(
                         f"blocking call '{cs.repr}' ({cat}) reachable"
-                        f" from async '{root.qualname}' via {via};"
+                        f" from {how} via {via};"
                         f" route it through Engine._blocking /"
                         f" run_in_executor / asyncio.to_thread"),
                     anchor=anchor))
+
+    for root in sorted(graph.async_functions(), key=lambda f: f.fid):
+        scan_root(root, f"async '{root.qualname}'")
+    for cb, sched_fn, sched_cs in _loop_callback_roots(graph):
+        prim = sched_cs.norm.rsplit(".", 1)[-1]
+        scan_root(cb, (f"loop-thread callback '{cb.qualname}'"
+                       f" (scheduled via {prim} in"
+                       f" '{sched_fn.qualname}')"))
     return findings
 
 

@@ -95,6 +95,19 @@ TRANSFER_INFLIGHT_BYTE_CAP = 64 * MiB
 # = the original synchronous seal-in-add_blob behavior.
 PACK_SEAL_WORKERS = 2
 PACK_SEAL_QUEUE_PACKFILES = 2
+# Streaming dataflow (docs/dataflow.md): blobs buffered below the
+# packfile target size are force-emitted into the seal pipeline once
+# they have waited this long, so the wire never starves behind the
+# end-of-tree flush while the packer walks small directories.
+PACK_EMIT_MAX_LAG_S = 2.0
+# Missed-wakeup backstop for the event-driven send loop: the seal
+# callback wakes the loop the moment a packfile commits; this timeout
+# only bounds how long a (theoretical) lost wakeup could park it.
+SEND_WAKEUP_BACKSTOP_S = 0.5
+# Host->device staging ring depth for manifest_segments_stream
+# (ops/pipeline.py): batch N+1's bytes upload asynchronously while
+# batch N runs scan->digest on device.
+PIPELINE_STAGE_DEPTH = 2
 
 # --- resumable WAN transfer plane (net/p2p.py send_file, docs/transfer.md) ---
 # Payloads larger than this go out as FILE_PART frames with per-part acks

@@ -180,7 +180,31 @@ class Orchestrator:
         self.failed = False
         self._resume = threading.Event()
         self._resume.set()
+        # seal->send wakeup (docs/dataflow.md): the packfile writer
+        # thread signals through call_soon_threadsafe(notify_packfile),
+        # so the send loop wakes the moment a packfile commits instead
+        # of polling on a backoff timer
+        self._packfile_event = asyncio.Event()
         self.active_transports: Dict[bytes, Transport] = {}
+
+    def notify_packfile(self) -> None:
+        """Event-loop side of the seal wakeup: a packfile committed (or
+        packing finished — the producer must fire this after flipping
+        ``packing_completed`` so a parked send loop sees the flag)."""
+        self._packfile_event.set()
+
+    async def wait_packfile(self, timeout: float) -> None:
+        """Park the send loop until the next seal commit.  ``timeout``
+        is only a missed-wakeup backstop, not pacing: the caller's loop
+        re-reads the buffer counter after every return either way."""
+        if self._packfile_event.is_set():
+            self._packfile_event.clear()
+            return
+        try:
+            await asyncio.wait_for(self._packfile_event.wait(), timeout)
+        except asyncio.TimeoutError:
+            return
+        self._packfile_event.clear()
 
     def adjust_buffer(self, delta: int) -> None:
         with self._buffer_lock:
@@ -257,6 +281,8 @@ class Engine:
         self.peer_stats = PeerStats(store)
         # per-backup dispatch/bytes/padding roll-up (obs/profile.py)
         self.last_pipeline_report = None
+        # per-backup overlap verdict (wall vs max stage, docs/dataflow.md)
+        self.last_overlap = None
         # most recent startup recovery sweep report (engine.recover)
         self.last_recovery: Optional[Dict] = None
 
@@ -1101,25 +1127,54 @@ class Engine:
             finally:
                 writer.shutdown()
 
+        # BKW_BACKUP_PHASED=1 is the sum(stage) baseline leg the bench
+        # speedup ratio is measured against: the send stage starts only
+        # after the full pack finished, so nothing overlaps the wire.
+        # Default is the streaming dataflow — pack, seal, and send all
+        # concurrently busy, linked by bounded queues (docs/dataflow.md).
+        phased = os.environ.get("BKW_BACKUP_PHASED", "0") == "1"
+        wall_t0 = time.monotonic()
         pack_fut = loop.run_in_executor(None, pack_thread)
-        send_task = asyncio.create_task(self._send_loop(orch, estimate))
+        send_task = None
+        if not phased:
+            send_task = asyncio.create_task(self._send_loop(orch, estimate))
         try:
             await pack_fut
             orch.packing_completed = True
+            # wake a send loop parked on the seal event: no more seal
+            # commits are coming, the drain check must run now
+            orch.notify_packfile()
             await self._blocking(self.index.flush)
         except BaseException:
             # BaseException on purpose: an injected CrashInjected (and a
             # cancel of this coroutine) must still tear down the send
             # loop instead of leaving it spinning against a dead backup
             orch.failed = True
-            send_task.cancel()
+            if send_task is not None:
+                send_task.cancel()
             raise
+        if send_task is None:
+            send_task = asyncio.create_task(self._send_loop(orch, estimate))
         try:
             await send_task
         except asyncio.CancelledError:
             raise EngineError("send pipeline cancelled")
+        wall_s = time.monotonic() - wall_t0
         snapshot = snapshot_holder["hash"]
         self.last_pack_stats = snapshot_holder["stats"]
+        # per-stage roll-up, derived from the metrics registry (delta vs.
+        # the baseline captured at run start) — one source of truth
+        # shared with GET /metrics and the messenger summary below
+        now_sums = _registry_stage_sums()
+        stages = {k: now_sums.get(k, 0.0) - stage_base.get(k, 0.0)
+                  for k in now_sums}
+        # overlap verdict for the dataflow gate: busy stages only (stall
+        # and send_wait are idle time by definition — counting them
+        # would reward a stalled pipeline)
+        self.last_overlap = obs_profile.overlap_report(
+            {k: stages.get(k, 0.0)
+             for k in ("chunk_hash", "seal", "write", "send")},
+            wall_s, mode="phased" if phased else "stream")
         # lineage + manifest commit (one store transaction): parent is
         # the previous retained head, so prune/GC can reason about the
         # chain (docs/lifecycle.md)
@@ -1142,15 +1197,9 @@ class Engine:
             bytes_read=snapshot_holder["stats"].bytes_read)
         self._log(f"backup finished: {snapshot.hex()}")
         if self.messenger is not None:
-            # the per-stage roll-up is now derived from the metrics
-            # registry (delta vs. the baseline captured at run start),
-            # not hand-carried through the pack thread — one source of
-            # truth shared with GET /metrics
-            now_sums = _registry_stage_sums()
-            stages = {k: now_sums.get(k, 0.0) - stage_base.get(k, 0.0)
-                      for k in now_sums}
             self.messenger.transfer("engine", "summary",
-                                    size=orch.bytes_sent, stages=stages)
+                                    size=orch.bytes_sent, stages=stages,
+                                    overlap=self.last_overlap)
         if tracing.enabled():
             self._log("trace spans:\n" + tracing.format_report())
         return snapshot
@@ -1177,6 +1226,10 @@ class Engine:
             self.orchestrator.bytes_written += size
             self.orchestrator.adjust_buffer(size)
             self._progress(bytes_on_disk=self.orchestrator.bytes_written)
+            # continuous admission: wake the send loop NOW — the buffer
+            # counter above is already visible, so the packfile can be
+            # on the wire before the next seal finishes
+            loop.call_soon_threadsafe(self.orchestrator.notify_packfile)
         return cb
 
     # --- send pipeline (send.rs) -------------------------------------------
@@ -1190,68 +1243,170 @@ class Engine:
         sched = self._transfers = TransferScheduler(
             messenger=self.messenger, peer_stats=self.peer_stats)
         # unified retry shapes (utils/retry.py): the storage re-request
-        # backs off across consecutive dry spells, the two pacing waits
-        # grow toward their caps while idle and reset on progress
+        # backs off across consecutive dry spells, the peer wait grows
+        # toward its cap while idle and resets on progress.  Waiting on
+        # the PACKER is not a retry anymore: the seal callback's event
+        # wakes this loop directly (Orchestrator.wait_packfile).
         request_timer = retry.RetryTimer(retry.STORAGE_REQUEST)
-        pack_wait = retry.Backoff(retry.SEND_IDLE)
         peer_wait = retry.Backoff(retry.PEER_WAIT)
-        while True:
-            buffer = orch.buffer_bytes
-            # backpressure (send.rs:52-54, 95-100)
-            if buffer > defaults.PACKFILE_LOCAL_BUFFER_LIMIT and not orch.paused:
-                orch.pause()
-                self._log("packing paused: local buffer full")
-            elif orch.paused and (defaults.PACKFILE_LOCAL_BUFFER_LIMIT - buffer
-                                  > defaults.PACKFILE_RESUME_THRESHOLD):
-                orch.resume()
-                self._log("packing resumed")
-            if buffer <= 0:
-                if not orch.packing_completed:
-                    await pack_wait.sleep()  # no dir scan on idle ticks
-                    continue
-                # counter says drained: confirm with one real scan before
-                # finishing (the counter is advisory, the dir is truth)
-                unsent = await self._blocking(self._unsent_packfiles)
-                if not unsent:
-                    break
-                orch.set_buffer(sum(s for _, _, s in unsent))
-            else:
-                unsent = await self._blocking(self._unsent_packfiles)
-                if not unsent:
-                    orch.set_buffer(0)
-                    continue
-            pack_wait.reset()
-            # erasure-first: any packfile that can reach RS_K+RS_M distinct
-            # peers right now goes out as a shard stripe; the rest fall
-            # through to the whole-file path below, so small swarms behave
-            # exactly as before sharding existed
-            unsent, striped = await self._send_stripes(orch, sched, unsent)
-            if striped:
-                fulfilled += striped
-                request_timer.reset()
-                self._progress(bytes_transmitted=orch.bytes_sent)
-            if not unsent:
-                continue
-            # a peer only qualifies if it can take the next packfile —
-            # otherwise an almost-full peer would be reacquired forever
-            # and the storage-request branch would starve
-            transport, peer_id, peer_free = await self._get_peer_connection(
-                orch, estimate, fulfilled, request_timer,
-                min_free=min(s for _, _, s in unsent))
-            if transport is None:
-                await peer_wait.sleep()
-                continue
-            peer_wait.reset()
-            request_timer.reset()
-            sent = await self._send_whole_files(
-                orch, sched, unsent, (transport, bytes(peer_id), peer_free))
-            if sent:
-                fulfilled += sent
-            else:
-                await self._drop_transport(orch, peer_id)
-                await peer_wait.sleep()
+        # continuous admission (docs/dataflow.md): every packfile handed
+        # to the transfer plane is tracked here (pid -> its admission
+        # tick's task) until its tick resolves.  The scan below skips
+        # tracked pids — a slow transfer never blocks admission of the
+        # next sealed packfile, and a file still on disk (it is unlinked
+        # only post-ack) is never double-submitted.
+        inflight: Dict[bytes, "asyncio.Task[int]"] = {}
+
+        async def reap(wait: bool) -> None:
+            """Fold finished admission ticks into the loop's accounting;
+            with ``wait`` parks until at least one tick resolves.  An
+            injected crash inside a tick re-raises here."""
+            nonlocal fulfilled
+            if not inflight:
+                return
+            done, _pending = await asyncio.wait(
+                set(inflight.values()), timeout=None if wait else 0,
+                return_when=asyncio.FIRST_COMPLETED)
+            if not done:
+                return
+            for pid in [p for p, t in inflight.items() if t in done]:
+                del inflight[pid]
+            for t in done:
+                placed = t.result()
+                if placed:
+                    fulfilled += placed
+                    peer_wait.reset()
+                    self._progress(bytes_transmitted=orch.bytes_sent)
+
+        async def reap_or_seal() -> None:
+            """Park until an in-flight tick resolves OR the next seal
+            commit — whichever lets the loop make progress first."""
+            waiter = asyncio.ensure_future(
+                orch.wait_packfile(defaults.SEND_WAKEUP_BACKSTOP_S))
+            try:
+                await asyncio.wait({waiter, *inflight.values()},
+                                   return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                if not waiter.done():
+                    waiter.cancel()
+                    try:
+                        await waiter
+                    except asyncio.CancelledError:
+                        pass
+            await reap(wait=False)
+
+        try:
+            while True:
+                buffer = orch.buffer_bytes
+                # backpressure (send.rs:52-54, 95-100)
+                if buffer > defaults.PACKFILE_LOCAL_BUFFER_LIMIT \
+                        and not orch.paused:
+                    orch.pause()
+                    self._log("packing paused: local buffer full")
+                elif orch.paused and (
+                        defaults.PACKFILE_LOCAL_BUFFER_LIMIT - buffer
+                        > defaults.PACKFILE_RESUME_THRESHOLD):
+                    orch.resume()
+                    self._log("packing resumed")
+                await reap(wait=False)
+                if buffer <= 0:
+                    if not orch.packing_completed:
+                        # event-driven: the seal callback wakes this loop
+                        # the moment a packfile commits (no dir scan, no
+                        # backoff poll); the timeout is only a
+                        # missed-wakeup backstop
+                        await orch.wait_packfile(
+                            defaults.SEND_WAKEUP_BACKSTOP_S)
+                        continue
+                    if inflight:
+                        await reap(wait=True)
+                        continue
+                    # counter says drained: confirm with one real scan
+                    # before finishing (the counter is advisory, the dir
+                    # is truth)
+                    unsent = await self._blocking(self._unsent_packfiles)
+                    if not unsent:
+                        break
+                    orch.set_buffer(sum(s for _, _, s in unsent))
+                else:
+                    unsent = await self._blocking(self._unsent_packfiles)
+                    unsent = [u for u in unsent
+                              if bytes(u[0]) not in inflight]
+                    if not unsent:
+                        if inflight:
+                            # everything on disk is already admitted:
+                            # wait for a completion or the next seal
+                            await reap_or_seal()
+                        elif not orch.packing_completed:
+                            await orch.wait_packfile(
+                                defaults.SEND_WAKEUP_BACKSTOP_S)
+                        else:
+                            orch.set_buffer(0)
+                        continue
+                # admit the fresh batch WITHOUT awaiting it: the tick
+                # task owns these pids until its transfers resolve, and
+                # the loop goes straight back to watching the seal queue
+                tick = asyncio.create_task(self._send_tick(
+                    orch, sched, unsent, estimate, fulfilled,
+                    request_timer, peer_wait))
+                for pid, _path, _size in unsent:
+                    inflight[bytes(pid)] = tick
+        except BaseException:
+            # teardown (cancel or injected crash): the admission ticks
+            # must not outlive the loop and spin against a dead backup
+            for t in set(inflight.values()):
+                t.cancel()
+            if inflight:
+                await asyncio.gather(*set(inflight.values()),
+                                     return_exceptions=True)
+            raise
         # index files last, watermarked (send.rs:135-176)
         await self._send_index_files(orch, estimate, fulfilled)
+
+    async def _send_tick(self, orch: Orchestrator, sched: TransferScheduler,
+                         unsent: list, estimate: int, fulfilled: int,
+                         request_timer, peer_wait) -> int:
+        """One admission batch: stripe what can reach k+m distinct peers,
+        fan the rest out whole-file.  Returns bytes fully placed; files
+        that could not go out stay on disk and leave the in-flight set
+        when this task resolves, so the next scan retries them.  The
+        peer-wait backoff on a dry tick happens HERE (while the pids are
+        still tracked), so a peerless swarm cannot spin the scan loop."""
+        placed = 0
+        # erasure-first: any packfile that can reach RS_K+RS_M distinct
+        # peers right now goes out as a shard stripe; the rest fall
+        # through to the whole-file path below, so small swarms behave
+        # exactly as before sharding existed
+        unsent, striped = await self._send_stripes(orch, sched, unsent)
+        if striped:
+            placed += striped
+            request_timer.reset()
+            self._progress(bytes_transmitted=orch.bytes_sent)
+        if not unsent:
+            return placed
+        # a peer only qualifies if it can take the next packfile —
+        # otherwise an almost-full peer would be reacquired forever
+        # and the storage-request branch would starve
+        transport, peer_id, peer_free = await self._get_peer_connection(
+            orch, estimate, fulfilled, request_timer,
+            min_free=min(s for _, _, s in unsent))
+        if transport is None:
+            await peer_wait.sleep()
+            return placed
+        peer_wait.reset()
+        request_timer.reset()
+        sent = await self._send_whole_files(
+            orch, sched, unsent, (transport, bytes(peer_id), peer_free))
+        if sent:
+            placed += sent
+        else:
+            if not sched.peer_busy(peer_id):
+                # dry tick on an idle socket: recycle it so the next scan
+                # re-evaluates peers fresh.  A busy socket stays — sibling
+                # ticks still have acks pending on it.
+                await self._drop_transport(orch, peer_id)
+            await peer_wait.sleep()
+        return placed
 
     async def _send_whole_files(self, orch: Orchestrator,
                                 sched: TransferScheduler, unsent: list,
@@ -1291,7 +1446,10 @@ class Engine:
                 label=f"pack:{bytes(pid).hex()[:8]}"))
         sent = 0
         dropped = set()
-        for r in await sched.gather(tasks):
+        # completion-order reap: a failed peer is dropped (its transport
+        # closed, its queued siblings failing fast) while the healthy
+        # peers' transfers are still in flight
+        async for r in sched.as_completed(tasks):
             if r.ok:
                 sent += r.size
             elif isinstance(r.error, P2PError) and r.peer_id not in dropped:
@@ -1636,6 +1794,7 @@ class Engine:
         usable = min_free - defaults.PEER_OVERUSE_GRACE // 2
 
         demoted = self.store.placement_demoted_peers()
+        sched = getattr(self, "_transfers", None)
         for peer_id, t in list(orch.active_transports.items()):
             if bytes(peer_id) in self._avoid_peers \
                     or bytes(peer_id) in demoted:
@@ -1645,12 +1804,21 @@ class Engine:
             free = peer.free_storage if peer else 0
             if free > 0 and free >= usable:
                 return t, peer_id, free
+            if sched is not None and sched.peer_busy(peer_id):
+                # too full for the NEXT file but a concurrent tick still
+                # has transfers in flight on this socket: keep it open.
+                # Closing here would strand the sibling's ack wait and
+                # force an abort-and-resume for a send that was fine.
+                continue
             await self._drop_transport(orch, peer_id)
         for peer in self.store.find_peers_with_storage(
                 exclude=self._avoid_peers):
             if peer.free_storage < usable:
                 continue  # capacity-ordered now, so keep scanning:
                 # a later (slower) peer may still have the space
+            if bytes(peer.pubkey) in orch.active_transports:
+                continue  # kept-busy transport above; dialing again would
+                # replace the registered socket and orphan its acks
             try:
                 t = await self.node.connect(peer.pubkey,
                                             wire.RequestType.TRANSPORT,
@@ -2185,6 +2353,7 @@ class Engine:
         try:
             await pack_fut
             orch.packing_completed = True
+            orch.notify_packfile()
             await self._blocking(self.index.flush)
         except BaseException:
             # BaseException on purpose: an injected CrashInjected (and a

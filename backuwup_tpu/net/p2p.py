@@ -203,6 +203,7 @@ class Transport:
         self.session_nonce = bytes(session_nonce)
         self.seq = first_seq
         self._acks: Dict[int, asyncio.Event] = {}
+        self._listen_done = False
         self._ack_task: Optional[asyncio.Task] = None
         self._recv_queue: asyncio.Queue = asyncio.Queue()
 
@@ -222,7 +223,7 @@ class Transport:
                 if body.header.session_nonce != self.session_nonce:
                     continue
                 if body.kind == wire.P2PBodyKind.ACK:
-                    ev = self._acks.get(body.acked_sequence)
+                    ev = self._acks.pop(body.acked_sequence, None)
                     if ev is not None:
                         ev.set()
                 else:
@@ -230,6 +231,17 @@ class Transport:
         except websockets.ConnectionClosed:
             pass
         finally:
+            # Wake every pending ack waiter: once this loop exits no ack
+            # can ever arrive, and a silent exit would strand concurrent
+            # senders for their full adaptive deadline (they'd count a
+            # stall for what is really a closed transport — e.g. a
+            # sibling admission tick dropping a peer it judged full).
+            # _listen_done distinguishes this sweep from a real ack:
+            # the waiter raises P2PError immediately into the
+            # abort-and-resume path instead of counting a stall.
+            self._listen_done = True
+            for ev in self._acks.values():
+                ev.set()
             # put_nowait (queue is unbounded): the await form would fail
             # with "Event loop is closed" when the task is GC'd at
             # interpreter/loop teardown
@@ -283,6 +295,13 @@ class Transport:
                 raise P2PError(
                     f"ack stalled for seq {seq}"
                     f" after {deadline:.1f}s") from e
+            if self._listen_done and seq in self._acks:
+                # woken by _listen's close-time sweep, not by an ack
+                # (a real ack pops the seq before setting the event):
+                # fail fast (no stall count — the link is gone, not slow)
+                # so run_resumable can redial and resume immediately
+                raise P2PError(
+                    f"transport closed while awaiting ack for seq {seq}")
         finally:
             self._acks.pop(seq, None)
 

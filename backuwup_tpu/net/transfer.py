@@ -122,6 +122,15 @@ class TransferScheduler:
         self.stage_s = {"wait": 0.0, "send": 0.0}
         self._cond = asyncio.Condition()
         self._peer_locks: Dict[bytes, asyncio.Lock] = {}
+        self._peer_pending: Dict[bytes, int] = {}
+
+    def peer_busy(self, peer_id: bytes) -> bool:
+        """True while any submitted transfer to ``peer_id`` is unresolved
+        (parked behind the per-peer lock or actively sending).  Connection
+        management consults this before closing a transport: dropping a
+        socket with pending jobs strands their ack waits and forces an
+        abort-and-resume redial for work that was proceeding fine."""
+        return self._peer_pending.get(bytes(peer_id), 0) > 0
 
     # --- admission (the in-flight byte cap) --------------------------------
 
@@ -171,6 +180,24 @@ class TransferScheduler:
     async def _run(self, peer_id: bytes, size: int,
                    send: Callable[[], Awaitable[None]],
                    label: str, direction: str = "send") -> TransferResult:
+        # pending-count bookkeeping wraps the whole job — including the
+        # park behind the per-peer lock — so peer_busy() covers queued
+        # work and survives cancellation mid-wait
+        self._peer_pending[peer_id] = self._peer_pending.get(peer_id, 0) + 1
+        try:
+            return await self._run_locked(peer_id, size, send, label,
+                                          direction)
+        finally:
+            n = self._peer_pending.get(peer_id, 1) - 1
+            if n <= 0:
+                self._peer_pending.pop(peer_id, None)
+            else:
+                self._peer_pending[peer_id] = n
+
+    async def _run_locked(self, peer_id: bytes, size: int,
+                          send: Callable[[], Awaitable[None]],
+                          label: str, direction: str = "send"
+                          ) -> TransferResult:
         t0 = time.monotonic()
         # Per-peer lock first: asyncio.Lock wakes waiters FIFO and tasks
         # run synchronously up to their first await, so same-peer
@@ -385,3 +412,17 @@ class TransferScheduler:
         if not tasks:
             return []
         return list(await asyncio.gather(*tasks))
+
+    @staticmethod
+    async def as_completed(tasks: List["asyncio.Task[TransferResult]"]):
+        """Yield each ``TransferResult`` the moment its transfer
+        resolves (completion order, not submission order) — the reap
+        side of continuous admission (docs/dataflow.md): the caller
+        reacts to a failed peer while its siblings are still on the
+        wire instead of after the whole batch gathers."""
+        pending = set(tasks)
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED)
+            for t in done:
+                yield t.result()

@@ -154,8 +154,24 @@ REPORT_SPANS = (
     "pipeline.scan_digest_collect",
     "pipeline.mesh_dispatch",
     "pipeline.mesh_collect",
+    "pipeline.h2d_stage",
     "packer.manifest_many",
 )
+
+# Streaming-dataflow overlap families (the engine's stage graph,
+# docs/dataflow.md): per-stage busy seconds attributed to one backup at
+# end of run, plus the overlap-efficiency verdict the bench
+# `20_dataflow` gate watches.  Declared here — the single construction
+# site for every bkw_* family — and folded by :func:`overlap_report`.
+_BACKUP_STAGE_BUSY = _metrics.counter(
+    "bkw_backup_stage_busy_seconds_total",
+    "Busy seconds per backup dataflow stage (chunk_hash / seal / write /"
+    " send), attributed per run from the stage-seconds registry deltas",
+    labelnames=("stage",))
+_BACKUP_OVERLAP = _metrics.gauge(
+    "bkw_backup_overlap_efficiency",
+    "max(per-stage busy seconds) / end-to-end wall for the most recent"
+    " backup; 1.0 means the wall clock converged to the slowest stage")
 
 
 def dispatch(stage: str, count: int = 1, actual_bytes: int = 0,
@@ -391,3 +407,33 @@ def emit_report(rep: dict, **fields) -> None:
     """Journal one ``pipeline_report`` event (no-op without a journal,
     like every obs emission)."""
     _journal.emit("pipeline_report", report=rep, **fields)
+
+
+def overlap_report(stage_busy: Dict[str, float], wall_s: float,
+                   mode: str = "stream") -> dict:
+    """Fold one backup's per-stage busy seconds into the overlap
+    families and return the summary row the engine stores + journals.
+
+    ``stage_busy`` must hold BUSY stages only — the caller excludes
+    idle/wait accumulators (pack stall, transfer admission wait), which
+    would otherwise reward a stalled pipeline.  Efficiency is
+    max(stage)/wall: 1.0 means the end-to-end wall clock collapsed onto
+    the slowest stage (perfect overlap); a phased run trends toward
+    max/sum.  Concurrent fan-out can legitimately push a stage's summed
+    busy seconds past the wall, so values above 1.0 are kept as-is."""
+    busy = {k: max(float(v), 0.0) for k, v in stage_busy.items()}
+    for stage, dt in busy.items():
+        if dt > 0:
+            _BACKUP_STAGE_BUSY.inc(dt, stage=stage)
+    max_stage = max(busy.values(), default=0.0)
+    eff = (max_stage / wall_s) if wall_s > 0 else 0.0
+    _BACKUP_OVERLAP.set(eff)
+    rep = {
+        "mode": mode,
+        "wall_s": round(wall_s, 6),
+        "stage_busy_s": {k: round(v, 6) for k, v in busy.items()},
+        "max_stage_s": round(max_stage, 6),
+        "overlap_efficiency": round(eff, 6),
+    }
+    _journal.emit("overlap_report", **rep)
+    return rep

@@ -423,6 +423,46 @@ class DevicePipeline:
                 per_row, pending = digs.popleft()
                 yield self.digest_collect(pending, per_row)
 
+    def manifest_segments_stream(self, host_segments,
+                                 strict_overflow: bool = False,
+                                 depth: Optional[int] = None):
+        """:meth:`manifest_segments` fed through a double-buffered
+        host->device staging ring (generator).
+
+        ``host_segments`` yields HOST ``(buf, nv)`` batches (numpy).  A
+        ring of ``depth`` (default ``defaults.PIPELINE_STAGE_DEPTH``, 2)
+        batches is kept staged ahead of consumption with
+        ``jax.device_put`` — an async H2D copy on real accelerators — so
+        batch N+1's bytes cross the host link while batch N runs
+        scan->digest on device.  The synchronous alternative
+        (``jnp.asarray`` inside the consuming loop) serializes every
+        upload against compute; that staging gap was PERF.md round-5
+        item 3.  Results are bit-identical to the non-staged driver.
+        """
+        from .. import defaults as _defaults
+        if depth is None:
+            depth = _defaults.PIPELINE_STAGE_DEPTH
+        depth = max(1, int(depth))
+        it = iter(host_segments)
+        ring: deque = deque()
+
+        def stage_one() -> bool:
+            for buf, nv in it:
+                with tracing.span("pipeline.h2d_stage"):
+                    ring.append((jax.device_put(buf), nv))
+                return True
+            return False
+
+        def staged():
+            while True:
+                while len(ring) < depth and stage_one():
+                    pass
+                if not ring:
+                    return
+                yield ring.popleft()
+
+        yield from self.manifest_segments(staged(), strict_overflow)
+
     def manifest_segments_device(self, segments, strict_overflow: bool = False,
                                  window: int = 4):
         """Zero-round-trip pipelined driver (generator).
@@ -819,13 +859,13 @@ class DevicePipeline:
         """
         out: List[Optional[Tuple[List[tuple], np.ndarray]]] = [None] * len(streams)
         groups = self._manifest_prepass(streams, out)
-        # stage resident batches lazily through the pipelined driver: at
-        # most ~3 batches (each bounded by the dispatch budget) live in HBM
-        # at once, however large the whole call is
+        # stage resident batches lazily through the pipelined driver
+        # behind the 2-deep H2D staging ring: at most ~3 batches (each
+        # bounded by the dispatch budget) live in HBM at once, and batch
+        # N+1's upload overlaps batch N's scan->digest
         batch_rows: deque = deque()
-        gen = ((jnp.asarray(b), nv) for b, nv in
-               self._bucketed_batches(streams, groups, batch_rows))
-        for results in self.manifest_segments(gen):
+        gen = self._bucketed_batches(streams, groups, batch_rows)
+        for results in self.manifest_segments_stream(gen):
             part = batch_rows.popleft()
             for r, i in enumerate(part):
                 out[i] = results[r]

@@ -88,6 +88,9 @@ class DirPacker:
         self.dedup_batch = dedup_batch
         self._device_sync: List[bytes] = []
         self.stats = PackStats()
+        # lag-bounded incremental emission (docs/dataflow.md): deadline
+        # for the next forced partial-packfile emission
+        self._emit_deadline = time.monotonic() + defaults.PACK_EMIT_MAX_LAG_S
 
     # --- blob plumbing -----------------------------------------------------
 
@@ -127,6 +130,22 @@ class DirPacker:
         if self.dedup_batch is not None and self._device_sync:
             self.dedup_batch(self._device_sync)
             self._device_sync.clear()
+
+    def _maybe_emit_partial(self) -> None:
+        """Incremental emission instead of end-of-tree flush: blobs
+        buffered below the packfile target size must not wait for
+        ``pack()``'s final flush longer than PACK_EMIT_MAX_LAG_S — on a
+        tree of many small directories that flush used to be the ONLY
+        emission, so the wire idled for the whole walk.  The deadline
+        re-arms whenever the writer is empty, so steady target-size
+        emission never pays extra sub-target packfiles."""
+        now = time.monotonic()
+        if not self.writer.pending_blobs:
+            self._emit_deadline = now + defaults.PACK_EMIT_MAX_LAG_S
+            return
+        if now >= self._emit_deadline:
+            self.writer.emit_partial()
+            self._emit_deadline = now + defaults.PACK_EMIT_MAX_LAG_S
 
     def _add_tree(self, tree: Tree) -> bytes:
         encoded = tree.encode_bytes()
@@ -211,6 +230,7 @@ class DirPacker:
                 self.stats.files += 1
                 self.progress(file=str(files[i]), bytes=len(data))
             self._flush_device_sync()
+            self._maybe_emit_partial()
             batch_idx.clear()
             batch_data.clear()
             batch_meta.clear()
@@ -349,6 +369,7 @@ class DirPacker:
             dir_hash[d] = self._tree_with_split(TreeKind.DIR, name, meta,
                                                 children)
             self.stats.dirs += 1
+            self._maybe_emit_partial()
         self._flush_device_sync()
         self.writer.flush()
         return dir_hash[root]

@@ -271,6 +271,20 @@ class PackfileWriter:
         if group:
             self._write_group(group)
 
+    def emit_partial(self) -> None:
+        """Hand whatever is buffered below the target size to the seal
+        pipeline NOW (the packer's lag bound, docs/dataflow.md), without
+        draining in-flight writes like :meth:`flush` does.  Packfile
+        boundaries move, bytes do not — the snapshot id is
+        content-addressed and independent of how blobs group into
+        packfiles, so partial emission never changes the snapshot."""
+        if self.seal_workers:
+            if self._batch:
+                self._submit_batch()
+            return
+        if self._pending:
+            self._write_packfile()
+
     def flush(self) -> None:
         if self.seal_workers:
             if self._batch:

@@ -155,13 +155,16 @@ class Messenger:
     def transfer(self, peer: str, outcome: str, size: int = 0,
                  inflight: int = 0, inflight_bytes: int = 0,
                  wait_ms: float = 0.0, send_ms: float = 0.0,
-                 label: str = "", stages: Optional[Dict] = None) -> None:
+                 label: str = "", stages: Optional[Dict] = None,
+                 overlap: Optional[Dict] = None) -> None:
         """Transfer-plane telemetry frame (net/transfer.py).
 
         ``outcome``: ``sent`` | ``failed`` per completed transfer, or
         ``summary`` for the end-of-run per-stage roll-up (``stages`` maps
-        stage name -> seconds: seal/write/wait/send).  ``inflight`` /
-        ``inflight_bytes`` are the plane's gauges at emission time.
+        stage name -> seconds: seal/write/wait/send, ``overlap`` is the
+        engine's wall-vs-max-stage verdict, docs/dataflow.md).
+        ``inflight`` / ``inflight_bytes`` are the plane's gauges at
+        emission time.
         """
         payload = {"peer": peer, "outcome": outcome, "size": size,
                    "inflight": inflight, "inflight_bytes": inflight_bytes,
@@ -170,6 +173,8 @@ class Messenger:
         if stages:
             payload["stages"] = {k: round(float(v), 4)
                                  for k, v in stages.items()}
+        if overlap:
+            payload["overlap"] = overlap
         self._emit(StatusEvent("transfer", payload))
 
     def error(self, text: str) -> None:

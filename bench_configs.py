@@ -1767,6 +1767,188 @@ def config19_sim(log: Callable) -> Dict:
             "scorecard": card}
 
 
+def config20_dataflow(log: Callable) -> Dict:
+    """Streaming dataflow vs phased backup — config #20 (docs/dataflow.md).
+
+    The SAME end-to-end backup (one source, N holders over loopback,
+    fault-plane latency on every send so the wire leg is comparable to
+    the pack leg on a one-core host) runs twice over identical corpora:
+
+      phased — ``BKW_BACKUP_PHASED=1``: the send loop starts only after
+               the packer finishes, wall = sum(stage), the pre-dataflow
+               shape
+      stream — shipped default: sealed packfiles enter transfer
+               admission the moment they commit, wall -> max(stage)
+
+    Gates (both hard):
+      * stream overlap efficiency ≥ ``BENCH_C20_EFFICIENCY_GATE``
+        (default 0.8, i.e. wall ≤ 1.25 x max per-stage busy seconds)
+      * phased_wall / stream_wall ≥ ``BENCH_C20_SPEEDUP_GATE`` (1.5)
+
+    Plus a correctness gate: both legs must produce the SAME snapshot
+    id — the root hash is content-addressed, so streaming emission
+    (lag-bounded partial packfiles, docs/dataflow.md) must be
+    byte-invisible in the snapshot.
+    """
+    import asyncio
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from backuwup_tpu import defaults
+    from backuwup_tpu.app import ClientApp
+    from backuwup_tpu.net.server import CoordinationServer
+    from backuwup_tpu.ops.backend import CpuBackend, NativeBackend
+    from backuwup_tpu.utils import faults
+
+    # 32 MiB / 8 ms tuned so pack wall and send wall are the same order
+    # on a 1-core CPU runner (~6s each): smaller corpora make pack
+    # trivially cheap (overlap can't show) and higher latency makes
+    # send dominate both legs (speedup ceiling falls toward 1.0)
+    total_mib = int(os.environ.get("BENCH_C20_MIB", "32"))
+    n_peers = int(os.environ.get("BENCH_C20_PEERS", "6"))
+    latency_s = float(os.environ.get("BENCH_C20_LATENCY_S", "0.008"))
+    eff_gate = float(os.environ.get("BENCH_C20_EFFICIENCY_GATE", "0.8"))
+    speedup_gate = float(os.environ.get("BENCH_C20_SPEEDUP_GATE", "1.5"))
+
+    # ACK_TIMEOUT_S: the injected per-send latency queues behind per-peer
+    # ordering, so a late ack is latency backlog, not a dead link — with
+    # the 5 s production floor the stall detector aborts ~1% of sends
+    # into resume retries and the measured walls pick up seconds of noise
+    saved = {k: getattr(defaults, k) for k in ("PACKFILE_TARGET_SIZE",
+                                               "ACK_TIMEOUT_S")}
+    tmp = Path(tempfile.mkdtemp(prefix="bkw_bench_c20_"))
+    rng = np.random.default_rng(20)
+    src = tmp / "src"
+    src.mkdir()
+    written = 0
+    i = 0
+    # Small-file-heavy corpus with a sprinkle of multi-chunk large files:
+    # per-file pack cost (chunk boundaries, manifest rows, dedup probes)
+    # is what gives the chunk/seal/write stages real wall time to overlap
+    # against the latency-bound send stage — a few big files would make
+    # pack trivially cheap and the overlap gate meaningless on CPU.
+    while written < (total_mib << 20):
+        sub = src / f"d{i % 6}"
+        sub.mkdir(exist_ok=True)
+        n = int(rng.integers(256 << 10, 768 << 10)) if i % 16 == 0 \
+            else int(rng.integers(4 << 10, 32 << 10))
+        (sub / f"f{i}").write_bytes(rng.bytes(n))
+        written += n
+        i += 1
+
+    async def one_backup(tag: str):
+        server = CoordinationServer(db_path=str(tmp / f"server_{tag}.db"))
+        port = await server.start()
+
+        def make_app(name):
+            params = CDCParams.from_desired(16 << 10)
+            try:
+                backend = NativeBackend(params)
+            except Exception:
+                backend = CpuBackend(params)
+            app = ClientApp(config_dir=tmp / tag / name / "cfg",
+                            data_dir=tmp / tag / name / "data",
+                            server_addr=f"127.0.0.1:{port}",
+                            backend=backend,
+                            tls=False)  # plaintext loopback deployment
+            app.store.set_backup_path(str(src))
+            return app
+
+        a = make_app("a")
+        holders = [make_app(f"p{j}") for j in range(n_peers)]
+        apps = [a] + holders
+        try:
+            for app in apps:
+                await app.start()
+                app._audit_task.cancel()
+            a.engine.auto_repair = False
+            amt = 8 * (written + (64 << 20)) // max(1, n_peers)
+            for peer in holders:
+                a.store.add_peer_negotiated(peer.client_id, amt)
+                peer.store.add_peer_negotiated(a.client_id, amt)
+                server.db.save_storage_negotiated(
+                    bytes(a.client_id), bytes(peer.client_id), amt)
+            snapshot = await asyncio.wait_for(a.backup(), 600)
+            if not snapshot:
+                raise RuntimeError(f"config #20 {tag}: backup returned none")
+            overlap = dict(a.engine.last_overlap or {})
+            return bytes(snapshot), overlap
+        finally:
+            for app in apps:
+                try:
+                    await app.stop()
+                except Exception:
+                    pass
+            await server.stop()
+
+    async def both() -> Dict:
+        defaults.PACKFILE_TARGET_SIZE = 128 * 1024
+        defaults.ACK_TIMEOUT_S = 60.0
+        # unmeasured warmup leg: eat the jit-compile walls once so the
+        # phased leg (which runs first) is not charged for them
+        await one_backup("warm")
+        faults.install(faults.FaultPlane(seed=20, latency=1.0,
+                                         latency_s=latency_s))
+        try:
+            # best-of-2 per leg: a 1-core runner's scheduler hiccups land
+            # on one leg at a time, so min-wall per mode compares the
+            # modes rather than the runner's worst moment.  Snapshot
+            # parity must hold across EVERY leg, best or not.
+            snaps_p, snaps_s = [], []
+            phased = stream = None
+            for rep in range(2):
+                os.environ["BKW_BACKUP_PHASED"] = "1"
+                try:
+                    snap_p, leg_p = await one_backup(f"phased{rep}")
+                finally:
+                    os.environ.pop("BKW_BACKUP_PHASED", None)
+                snaps_p.append(snap_p)
+                if phased is None or leg_p["wall_s"] < phased["wall_s"]:
+                    phased = leg_p
+                snap_s, leg_s = await one_backup(f"stream{rep}")
+                snaps_s.append(snap_s)
+                if stream is None or leg_s["wall_s"] < stream["wall_s"]:
+                    stream = leg_s
+            return {"snaps_phased": snaps_p, "snaps_stream": snaps_s,
+                    "phased": phased, "stream": stream}
+        finally:
+            faults.uninstall()
+
+    try:
+        r = asyncio.run(both())
+        data_mib = written / (1 << 20)
+        phased, stream = r["phased"], r["stream"]
+        speedup = phased["wall_s"] / max(stream["wall_s"], 1e-9)
+        efficiency = stream["overlap_efficiency"]
+        identical = len(set(r["snaps_phased"] + r["snaps_stream"])) == 1
+        passed = (identical and efficiency >= eff_gate
+                  and speedup >= speedup_gate)
+        log(f"config#20 dataflow: {data_mib:.0f} MiB to {n_peers} peers "
+            f"(+{latency_s * 1000:.0f}ms/send): phased "
+            f"{phased['wall_s']:.2f}s -> stream {stream['wall_s']:.2f}s "
+            f"= {speedup:.2f}x (gate {speedup_gate}x), overlap "
+            f"{efficiency:.2f} (gate {eff_gate}), snapshot "
+            f"{'identical' if identical else 'DIVERGED'} "
+            f"[{'PASS' if passed else 'FAIL'}]")
+        return {"passed": passed,
+                "mib_s": round(data_mib / stream["wall_s"], 2),
+                "dataflow_overlap_efficiency": round(efficiency, 4),
+                "dataflow_speedup": round(speedup, 2),
+                "snapshot_identical": identical,
+                "phased_wall_s": round(phased["wall_s"], 3),
+                "stream_wall_s": round(stream["wall_s"], 3),
+                "stream_stage_busy_s": stream["stage_busy_s"],
+                "phased_stage_busy_s": phased["stage_busy_s"],
+                "peers": n_peers,
+                "latency_ms": round(latency_s * 1000, 1),
+                "wall_s": round(phased["wall_s"] + stream["wall_s"], 2)}
+    finally:
+        for k, v in saved.items():
+            setattr(defaults, k, v)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -1790,7 +1972,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("16_federation", lambda: config16_federation(log)),
             ("17_tiered", lambda: config17_tiered(log)),
             ("18_replication", lambda: config18_replication(log)),
-            ("19_sim", lambda: config19_sim(log))):
+            ("19_sim", lambda: config19_sim(log)),
+            ("20_dataflow", lambda: config20_dataflow(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

@@ -203,6 +203,18 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "sim bench recapture FAILED (see $simj) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated dataflow recapture: config #20 alone (host-only
+        # loopback p2p: phased-vs-stream legs over one corpus) — the
+        # dataflow_speedup / overlap_efficiency verdict survives even
+        # when the device suite timed out partway
+        dfl="$BENCH_OUT_DIR/BENCH_dataflow_${stamp}.json"
+        if timeout "${BENCH_DATAFLOW_TIMEOUT_S:-900}" \
+                env BENCH_ONLY_CONFIG=20_dataflow BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$dfl" 2>>/tmp/tpu_watch.log; then
+            echo "dataflow bench recaptured to $dfl at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "dataflow bench recapture FAILED (see $dfl) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

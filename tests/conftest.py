@@ -92,6 +92,11 @@ def pytest_configure(config):
         " (obs/profile.py dev timer); excluded from tier-1 like accel —"
         " set BKW_PROFILE_TESTS=1 to run them")
     config.addinivalue_line(
+        "markers", "dataflow: streaming backup dataflow tests (bounded"
+        " inter-stage queues, backpressure, event-driven seal->send"
+        " wakeup, phased-vs-stream parity, docs/dataflow.md); all"
+        " tier-1")
+    config.addinivalue_line(
         "markers", "sim: virtual-clock simulation-plane tests"
         " (backuwup_tpu/sim, docs/simulation.md); the 10^5-client"
         " simulated-week builtin is tier-1, the 10^6 soak is also"

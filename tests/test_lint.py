@@ -91,6 +91,49 @@ def test_bkw001_sqlite_and_alias_normalization(tmp_path):
     assert "sqlite3" in report.findings[0].message
 
 
+def test_bkw001_loop_scheduled_callback_is_a_root(tmp_path):
+    # a sync callable handed to call_soon_threadsafe runs ON the loop
+    # thread — blocking work inside it must fire even though no async
+    # body ever calls it
+    root = _mk_pkg(tmp_path, {"a.py": (
+        "import asyncio, time\n"
+        "def wake():\n"
+        "    time.sleep(1)\n"
+        "def writer_thread(loop):\n"
+        "    loop.call_soon_threadsafe(wake)\n")})
+    report = _lint(root, {"BKW001"})
+    assert len(report.findings) == 1
+    f = report.findings[0]
+    assert "time.sleep" in f.message and "wake" in f.message
+    assert "call_soon_threadsafe" in f.message
+
+
+def test_bkw001_event_setting_callback_and_done_callback(tmp_path):
+    # the dataflow wakeup shape: a callback that only sets an event is
+    # clean, and add_done_callback targets are scanned the same way
+    root = _mk_pkg(tmp_path, {"a.py": (
+        "import asyncio, time\n"
+        "class Orch:\n"
+        "    def __init__(self):\n"
+        "        self.ev = asyncio.Event()\n"
+        "    def notify(self):\n"
+        "        self.ev.set()\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self.orch = Orch()\n"
+        "    def writer_thread(self, loop):\n"
+        "        loop.call_soon_threadsafe(self.orch.notify)\n"
+        "def log_done(fut):\n"
+        "    time.sleep(1)\n"
+        "async def serve():\n"
+        "    fut = asyncio.get_running_loop().create_future()\n"
+        "    fut.add_done_callback(log_done)\n")})
+    report = _lint(root, {"BKW001"})
+    assert len(report.findings) == 1
+    f = report.findings[0]
+    assert "log_done" in f.message and "add_done_callback" in f.message
+
+
 # --- BKW002: lock held across await -----------------------------------------
 
 
