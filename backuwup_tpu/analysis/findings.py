@@ -40,6 +40,9 @@ RULE_IDS: Dict[str, str] = {
               " serve/dispatch arm in net/p2p.py",
     "BKW006": "sim-covered modules read time only through the"
               " utils/clock.py seam",
+    "BKW007": "every SLO catalog entry burns against a constructed"
+              " bkw_* family with a valid label subset (and is"
+              " documented, both directions)",
 }
 
 

@@ -34,6 +34,8 @@ CLOCKED_MODULES = (
     "net/matchmaking.py",
     "net/peer_stats.py",
     "obs/invariants.py",
+    "obs/series.py",
+    "obs/slo.py",
 )
 CLOCKED_PREFIXES = ("sim/",)
 

@@ -1,0 +1,188 @@
+"""BKW007: SLO-catalog sync — objectives vs metric families vs docs.
+
+The SLO plane (obs/slo.py) is declarative: ``defaults.SLO_CATALOG``
+names the ``bkw_*`` family each objective burns against.  A typo'd
+family or a label that no construction site declares would make the
+objective silently score burn 0 forever — the exact failure mode a
+declarative catalog exists to prevent.  This rule checks, without
+importing anything:
+
+* the catalog literal parses (``ast.literal_eval`` on the assignment);
+* every entry is well-formed (id, known kind, positive budget, ratio
+  entries carry ``total_family``);
+* every referenced family — ``family`` and ``total_family`` — is
+  constructed somewhere (reusing BKW004's collector), and the entry's
+  ``labels`` keys are a subset of the family's declared label set;
+* both directions against ``docs/observability.md``'s Objectives
+  table: every catalog id has a doc row, every doc row names a catalog
+  id, and the doc row's family matches the catalog's.
+
+Doc rows are recognized by shape: a table row whose FIRST cell carries
+a backticked non-``bkw_`` identifier and whose later cells carry a
+backticked ``bkw_*`` family — disjoint from BKW004's catalog-table
+rows, which put the family itself in the first cell.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from .callgraph import CallGraph
+from .findings import SEV_ERROR, Finding
+from .rules_drift import collect_metric_families
+
+CATALOG_MODULE = "defaults.py"
+CATALOG_NAME = "SLO_CATALOG"
+KNOWN_KINDS = ("counter_rate", "ratio", "quantile", "gauge_below")
+
+_DOC_ID_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
+_DOC_FAMILY_RE = re.compile(r"`(bkw_[a-zA-Z0-9_]+)`")
+
+
+def load_catalog(graph: CallGraph):
+    """(entries, line) from the literal assignment, or (None, line) when
+    the assignment exists but is not a pure literal."""
+    mod = graph.pkg.modules.get(CATALOG_MODULE)
+    if mod is None:
+        return None, 1
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == CATALOG_NAME
+                   for t in node.targets):
+            continue
+        try:
+            return ast.literal_eval(node.value), node.lineno
+        except (ValueError, TypeError, SyntaxError):
+            return None, node.lineno
+    return None, 1
+
+
+def parse_objectives_doc(doc_path: Path) -> Dict[str, dict]:
+    """objective id -> {line, families} from the doc's Objectives table
+    rows (non-bkw backticked id in the first cell, a ``bkw_*`` family in
+    a later cell)."""
+    out: Dict[str, dict] = {}
+    for i, raw in enumerate(doc_path.read_text().splitlines(), start=1):
+        line = raw.strip()
+        if not line.startswith("|") or line.startswith("|---") \
+                or line.startswith("| Objective"):
+            continue
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        if len(cells) < 4:
+            continue
+        ids = [t for t in _DOC_ID_RE.findall(cells[0])
+               if not t.startswith("bkw_")]
+        if not ids or _DOC_FAMILY_RE.findall(cells[0]):
+            continue  # a BKW004 catalog row, not an objective row
+        families = tuple(fam for cell in cells[1:]
+                         for fam in _DOC_FAMILY_RE.findall(cell))
+        if not families:
+            continue
+        out.setdefault(ids[0], {"line": i, "families": families})
+    return out
+
+
+def check_bkw007(graph: CallGraph,
+                 doc_path: Optional[Path]) -> List[Finding]:
+    findings: List[Finding] = []
+    entries, line = load_catalog(graph)
+    if entries is None:
+        findings.append(Finding(
+            rule="BKW007", severity=SEV_ERROR, path=CATALOG_MODULE,
+            line=line,
+            message=(f"{CATALOG_NAME} is missing or not a pure literal"
+                     f" — the SLO catalog must be statically checkable"),
+            anchor="slo-unparsable-catalog"))
+        return findings
+
+    families = collect_metric_families(graph)
+
+    def family_labels(fam: str):
+        sets = {s["labels"] for s in families.get(fam, ())
+                if s["labels"] is not None}
+        return set().union(*sets) if sets else set()
+
+    seen: Dict[str, dict] = {}
+    for idx, entry in enumerate(entries):
+        oid = str(entry.get("id", "")) if isinstance(entry, dict) else ""
+        kind = entry.get("kind") if isinstance(entry, dict) else None
+        budget = entry.get("budget", 0) if isinstance(entry, dict) else 0
+        bad = (not oid or oid in seen or kind not in KNOWN_KINDS
+               or not isinstance(budget, (int, float)) or budget <= 0
+               or (kind == "ratio" and not entry.get("total_family")))
+        if bad:
+            findings.append(Finding(
+                rule="BKW007", severity=SEV_ERROR, path=CATALOG_MODULE,
+                line=line,
+                message=(f"SLO catalog entry #{idx} ({oid or '?'}) is"
+                         f" malformed: needs a unique id, kind in"
+                         f" {KNOWN_KINDS}, budget > 0, and"
+                         f" total_family for ratio kinds"),
+                anchor=f"slo-bad-entry:{oid or idx}"))
+            continue
+        seen[oid] = entry
+        refs = [("family", str(entry.get("family", "")))]
+        if entry.get("total_family"):
+            refs.append(("total_family", str(entry["total_family"])))
+        for role, fam in refs:
+            if fam not in families:
+                findings.append(Finding(
+                    rule="BKW007", severity=SEV_ERROR,
+                    path=CATALOG_MODULE, line=line,
+                    message=(f"SLO objective '{oid}' {role} '{fam}' is"
+                             f" not constructed anywhere — the"
+                             f" objective would score burn 0 forever"),
+                    anchor=f"slo-unknown-family:{oid}:{role}"))
+        extra = set(dict(entry.get("labels") or {})) \
+            - family_labels(str(entry.get("family", "")))
+        if entry.get("family") in families and extra:
+            findings.append(Finding(
+                rule="BKW007", severity=SEV_ERROR, path=CATALOG_MODULE,
+                line=line,
+                message=(f"SLO objective '{oid}' selects labels"
+                         f" {sorted(extra)} that family"
+                         f" '{entry['family']}' does not declare"),
+                anchor=f"slo-label-drift:{oid}"))
+
+    if doc_path is None or not Path(doc_path).exists():
+        if seen:
+            findings.append(Finding(
+                rule="BKW007", severity=SEV_ERROR, path="docs", line=1,
+                message=("objectives document not found; cannot check"
+                         " SLO catalog sync"),
+                anchor="slo-missing-doc"))
+        return findings
+
+    doc = parse_objectives_doc(Path(doc_path))
+    doc_rel = Path(doc_path).name
+    for oid, entry in sorted(seen.items()):
+        row = doc.get(oid)
+        if row is None:
+            findings.append(Finding(
+                rule="BKW007", severity=SEV_ERROR, path=CATALOG_MODULE,
+                line=line,
+                message=(f"SLO objective '{oid}' has no row in the"
+                         f" {doc_rel} Objectives table"),
+                anchor=f"slo-undocumented:{oid}"))
+        elif str(entry.get("family", "")) not in row["families"]:
+            findings.append(Finding(
+                rule="BKW007", severity=SEV_ERROR,
+                path=f"docs/{doc_rel}", line=row["line"],
+                message=(f"Objectives row for '{oid}' names"
+                         f" {row['families']} but the catalog burns"
+                         f" against '{entry.get('family')}'"),
+                anchor=f"slo-doc-family-drift:{oid}"))
+    for oid, row in sorted(doc.items()):
+        if oid not in seen:
+            findings.append(Finding(
+                rule="BKW007", severity=SEV_ERROR,
+                path=f"docs/{doc_rel}", line=row["line"],
+                message=(f"Objectives table documents '{oid}' but"
+                         f" {CATALOG_NAME} has no such entry — prune"
+                         f" the row or restore the objective"),
+                anchor=f"slo-uncatalogued:{oid}"))
+    return findings

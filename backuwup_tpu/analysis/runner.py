@@ -20,6 +20,7 @@ from .rules_async import check_bkw001, check_bkw002
 from .rules_clock import check_bkw006
 from .rules_crash import check_bkw003
 from .rules_drift import check_bkw004, check_bkw005
+from .rules_slo import check_bkw007
 
 
 @dataclass
@@ -49,6 +50,7 @@ def _rule_table(cfg: LintConfig) -> Dict[str, Callable[[CallGraph],
         "BKW004": lambda g: check_bkw004(g, cfg.doc_path),
         "BKW005": check_bkw005,
         "BKW006": check_bkw006,
+        "BKW007": lambda g: check_bkw007(g, cfg.doc_path),
     }
 
 

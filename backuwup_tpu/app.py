@@ -18,7 +18,10 @@ from .crypto import KeyManager
 from .engine import Engine
 from .net.client import ServerClient
 from .net.p2p import P2PNode, ReceivedFilesWriter, Receiver
+from .obs import diagnose as obs_diagnose
+from .obs import slo as obs_slo
 from .obs.invariants import InvariantMonitor
+from .obs.series import SeriesRecorder
 from .ops.backend import ChunkerBackend
 from .store import Store
 from .ui.messenger import Messenger
@@ -81,8 +84,24 @@ class ClientApp:
                              dedup_mesh=dedup_mesh)
         self.monitor = InvariantMonitor(self.store, index=self.engine.index,
                                         client=self.client_id.hex()[:8])
+        # live SLO plane: ring-buffer history over the catalog's families
+        # plus the durability scoreboard, burn-rate evaluation riding the
+        # same cadence, diagnosis on breach (docs/observability.md §SLOs)
+        slo_catalog = obs_slo.parse_catalog()
+        families = sorted({o.family for o in slo_catalog}
+                          | {o.total_family for o in slo_catalog
+                             if o.total_family}
+                          | {"bkw_durability_status",
+                             "bkw_durability_repair_debt_bytes"})
+        self.series = SeriesRecorder(families)
+        self.slo = obs_slo.SLOMonitor(
+            self.series, catalog=slo_catalog,
+            on_breach=lambda breach: obs_diagnose.explain(
+                breach, recorder=self.series),
+            client=self.client_id.hex()[:8])
         self._audit_task: Optional[asyncio.Task] = None
         self._monitor_task: Optional[asyncio.Task] = None
+        self._slo_task: Optional[asyncio.Task] = None
         if status_port is None:
             env_port = os.environ.get("BKW_STATUS_PORT", "")
             status_port = int(env_port) if env_port else None
@@ -127,6 +146,10 @@ class ClientApp:
             # janitor's clock, so abandoned partials age out without a
             # restart (engine.expire_partials also runs in recovery)
             self.monitor.run(janitor=self.engine.expire_partials))
+        self._slo_task = asyncio.create_task(
+            # series sampling and burn-rate evaluation ride one cadence so
+            # every evaluation judges a freshly appended point
+            self.series.run(on_sample=self.slo.evaluate))
         if self._status_port_req is not None:
             from .obs.expo import StatusServer
             self._status_server = StatusServer(
@@ -136,7 +159,10 @@ class ClientApp:
                     "busy": self.engine._exclusive.locked(),
                     # sweep on demand: health is never staler than the ask
                     "durability": self.monitor.sweep().summary,
-                    "status": self.monitor.last_report.status},
+                    "slo": self.slo.summary(),
+                    "status": obs_slo.join_status(
+                        self.monitor.last_report.status,
+                        self.slo.summary()["status"])},
                 before_metrics=lambda: self.monitor.sweep())
             self.status_port = await self._status_server.start()
             self.messenger.log(
@@ -162,6 +188,13 @@ class ClientApp:
             except (asyncio.CancelledError, Exception):
                 pass
             self._monitor_task = None
+        if self._slo_task is not None:
+            self._slo_task.cancel()
+            try:
+                await self._slo_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._slo_task = None
         await self.engine.aclose()
         await self.server.close()
         self.store.close()

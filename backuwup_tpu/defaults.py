@@ -216,6 +216,81 @@ OBS_PANIC_TAIL_LINES = 200  # journal lines embedded in a panic dump
 # one stalled send cratering a peer's score.
 PEER_STATS_ALPHA = 0.2
 
+# --- live SLO plane (obs/series.py, obs/slo.py, obs/diagnose.py,
+# docs/observability.md §SLOs; no reference equivalent) ------------------------
+# Registry sampling cadence of the in-process time-series recorder and
+# the ring-buffer depth per series.  At the default 10 s cadence 2048
+# points retain ~5.7 h — enough to feed the 1 h fast burn window with
+# real headroom; the 6 h/3 d slow windows clamp to available history
+# while the buffer fills (burn math uses the actual covered span).
+SERIES_SAMPLE_INTERVAL_S = 10.0
+SERIES_RETENTION_POINTS = 2048
+# Robust-zscore anomaly flagging: |z| at/above this flags a series, and
+# a series needs this many points in the window before it is judged at
+# all (a two-point baseline flags everything).
+SERIES_ANOMALY_Z = 3.5
+SERIES_ANOMALY_MIN_POINTS = 6
+# Google-SRE multi-window burn alerts: the fast pair catches an active
+# incident (page-grade), the slow pair a smoldering budget leak
+# (ticket-grade).  Both windows of a pair must burn past the threshold
+# before the objective's status moves — one spike in a short window is
+# not an incident.  The sim plane reuses these spans verbatim on
+# virtual time; the scenario harness shrinks them via the monitor's
+# ``windows=`` override.
+SLO_WINDOWS = ((300.0, 3600.0), (21600.0, 259200.0))
+SLO_FAST_BURN = 14.4
+SLO_SLOW_BURN = 6.0
+# The declarative objective catalog (bkwlint BKW007 keeps it honest
+# against the registered metric families and the docs table).  Entries
+# are plain literals — the linter AST-parses this tuple, so no computed
+# values.  ``budget`` is the tolerated bad-event fraction (error
+# budget); ``burn = bad_fraction / budget``.  Kinds:
+#   counter_rate — bad seconds per clock second (delta / covered span)
+#   ratio        — bad events / total events (needs total_family)
+#   quantile     — histogram observations above target / all in window
+#   gauge_below  — window samples below target / all samples
+SLO_CATALOG = (
+    {"id": "durability", "kind": "counter_rate",
+     "family": "bkw_durability_violation_seconds_total", "labels": {},
+     "budget": 0.001,
+     "description": "fraction of time any durability invariant is"
+                    " violated stays ~0"},
+    {"id": "transfer_stalls", "kind": "ratio",
+     "family": "bkw_transfer_stalls_total", "labels": {},
+     "total_family": "bkw_transfers_total", "budget": 0.02,
+     "description": "adaptive-deadline stall aborts per completed"
+                    " transfer"},
+    {"id": "backup_p99", "kind": "quantile",
+     "family": "bkw_span_seconds", "labels": {"name": "engine.backup"},
+     "target": 120.0, "budget": 0.01,
+     "description": "p99 end-to-end backup wall seconds"},
+    {"id": "restore_p99", "kind": "quantile",
+     "family": "bkw_span_seconds", "labels": {"name": "engine.restore"},
+     "target": 120.0, "budget": 0.01,
+     "description": "p99 end-to-end restore wall seconds"},
+    {"id": "matchmaking_p99", "kind": "quantile",
+     "family": "bkw_server_request_seconds",
+     "labels": {"route": "/backups/request"},
+     "target": 5.0, "budget": 0.01,
+     "description": "p99 matchmaking request latency at the"
+                    " coordination server"},
+    {"id": "backup_overlap", "kind": "gauge_below",
+     "family": "bkw_backup_overlap_efficiency", "labels": {},
+     "target": 0.5, "budget": 0.25,
+     "description": "streaming-dataflow overlap efficiency holds above"
+                    " the floor for most of the window"},
+    {"id": "repl_promote_p99", "kind": "quantile",
+     "family": "bkw_repl_promote_seconds", "labels": {},
+     "target": 30.0, "budget": 0.05,
+     "description": "p99 successor promotion seconds (epoch commit +"
+                    " log-tail replay)"},
+)
+# Evidence ranking for the breach explainer (obs/diagnose.py): how far
+# back from the breach instant evidence is gathered when the caller
+# does not pin a window, and how many ranked causes a report keeps.
+DIAGNOSE_WINDOW_S = 600.0
+DIAGNOSE_TOP_CAUSES = 5
+
 # --- durability invariant monitor (obs/invariants.py, docs/scenarios.md) -----
 # Background sweep cadence of the client's InvariantMonitor; health is
 # current within one interval of any placement/ledger change.

@@ -1960,6 +1960,10 @@ class Engine:
             self.messenger.audit(hexid, outcome, detail=detail,
                                  demoted=state.demoted)
         if state.demoted:
+            # journal the demotion so the breach explainer can rank it
+            # against armed fault sites in the breach window
+            obs_journal.emit("placement_demotion", peer=hexid[:8],
+                            outcome=outcome, misses=state.misses)
             self._on_peer_demoted(peer_id)
 
     # --- peer-loss repair ----------------------------------------------------

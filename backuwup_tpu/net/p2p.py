@@ -839,7 +839,10 @@ class P2PNode:
         plane = faults.PLANE
         if plane is not None and (plane.is_dead(peer_id)
                                   or plane.is_dead(self.keys.client_id)):
-            # fail fast, exactly like a dial to a vanished host
+            # fail fast, exactly like a dial to a vanished host; recorded
+            # so the breach explainer sees kill evidence (obs/diagnose.py)
+            dead = peer_id if plane.is_dead(peer_id) else self.keys.client_id
+            faults._record_injection(f"dial.dead:{dead.hex()[:8]}")
             raise P2PError("injected: peer is dead")
         if plane is not None and plane.flaky_reconnect(peer_id):
             # the residential-NAT reconnect lottery: this dial attempt is

@@ -50,6 +50,7 @@ from ..crypto import verify_signature
 from ..obs import expo as obs_expo
 from ..obs import invariants as obs_invariants
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from .matchmaking import (_MATCHMAKINGS, _QUEUE_DEPTH,  # noqa: F401
                           ShardedMatchmaker)
@@ -1094,13 +1095,16 @@ class CoordinationServer:
         scenario harness, tests, bench).  A violated invariant turns
         the whole document 503 (obs/expo.py)."""
         durability = obs_invariants.summary_from_registry()
+        slo = obs_slo.summary_from_registry()
         return obs_expo.health_response(
             schema_version=await self.db.aio.schema_version(),
             queue_depth=self.queue.pending(),
             connected_clients=self.connections.count(),
             uptime_s=round(time.time() - self._started, 3),
             durability=durability,
-            status=durability["status"])
+            slo=slo,
+            status=obs_slo.join_status(durability["status"],
+                                       slo["status"]))
 
     async def ws(self, request):
         token = request.headers.get("Authorization")

@@ -74,8 +74,12 @@ from .. import defaults
 from ..app import ClientApp
 from ..engine import EngineError
 from ..net.server import CoordinationServer
+from ..obs import diagnose as obs_diagnose
 from ..obs import invariants as obs_invariants
+from ..obs import journal as obs_journal
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
+from ..obs.series import SeriesRecorder
 from ..ops.backend import ChunkerBackend, CpuBackend
 from ..net.peer_stats import PeerEstimate
 from ..ops.gear import CDCParams
@@ -124,6 +128,17 @@ class ScenarioSpec:
     expect_violation: bool = False
     expect_final_status: str = "ok"
     min_shards_rebuilt: int = 0
+    #: opt into the live SLO plane: a journal at the workdir, series
+    #: sampling + burn-rate evaluation riding the invariant sampler, a
+    #: diagnosis report on breach, and the slo_* gates
+    slo: bool = False
+    #: catalog subset to evaluate — loopback runs keep the objectives
+    #: whose healthy baseline is provably quiet (overlap efficiency on a
+    #: tiny synthetic corpus is not)
+    slo_objectives: tuple = ("durability", "transfer_stalls",
+                             "backup_p99", "restore_p99")
+    #: multi-window pairs shrunk onto loopback seconds
+    slo_windows: tuple = ((1.0, 3.0), (6.0, 18.0))
 
 
 #: The sender-side commit seams a scenario backup crosses, i.e. the
@@ -195,6 +210,10 @@ class ScenarioHarness:
         self._saved: Dict = {}
         self._grown = 0
         self._restores = 0
+        self.series: Optional[SeriesRecorder] = None
+        self.slo: Optional[obs_slo.SLOMonitor] = None
+        self.diagnoses: List[dict] = []
+        self._saved_journal = None
 
     # --- lifecycle ---------------------------------------------------------
 
@@ -229,8 +248,24 @@ class ScenarioHarness:
             # would inject nondeterminism
             app._audit_task.cancel()
             app._monitor_task.cancel()
+            app._slo_task.cancel()
         self.a.engine.auto_repair = False
         self.monitor = self.a.monitor
+        if spec.slo:
+            self._saved_journal = obs_journal.get()
+            obs_journal.install(obs_journal.Journal(
+                self.workdir / "journal.jsonl"))
+            catalog = [o for o in obs_slo.parse_catalog()
+                       if o.id in spec.slo_objectives]
+            families = sorted({o.family for o in catalog}
+                              | {o.total_family for o in catalog
+                                 if o.total_family})
+            self.series = SeriesRecorder(families)
+            self.slo = obs_slo.SLOMonitor(
+                self.series, catalog=catalog,
+                windows=spec.slo_windows,
+                on_breach=self._on_breach,
+                client=self.a.client_id.hex()[:8])
 
         # manual negotiation (matchmaking has its own tests); holders get
         # the larger allowance so free-space ordering stripes onto them
@@ -261,6 +296,10 @@ class ScenarioHarness:
         if self.server is not None:
             await self.server.stop()
         faults.uninstall()
+        if self.spec.slo:
+            obs_journal.uninstall()
+            if self._saved_journal is not None:
+                obs_journal.install(self._saved_journal)
         for k, v in self._saved.items():
             setattr(defaults, k, v)
 
@@ -303,6 +342,15 @@ class ScenarioHarness:
 
     # --- invariant sampling ------------------------------------------------
 
+    def _on_breach(self, breach) -> None:
+        """SLO breach hook: diagnose against the run's journal + series
+        history, keep the report for the gates."""
+        self.facts.setdefault("slo_breaches", []).append({
+            "objective": breach.objective, "status": breach.status,
+            "t": round(time.time() - self.t0, 3)})
+        report = obs_diagnose.explain(breach, recorder=self.series)
+        self.diagnoses.append(report)
+
     def _sample_once(self) -> None:
         if self.monitor is None:  # crash-phase restart window: no live client
             return
@@ -318,6 +366,11 @@ class ScenarioHarness:
             "repair_debt_bytes": rep.repair_debt_bytes,
             "orphaned_placements": rep.orphaned_placements,
         })
+        if self.slo is not None:
+            # the SLO plane rides the invariant sampler's cadence: every
+            # evaluation judges the sweep that just published
+            self.series.sample()
+            self.slo.evaluate()
 
     async def _sampler(self) -> None:
         while True:
@@ -440,6 +493,7 @@ class ScenarioHarness:
         if len(victims) < ph.count:
             raise ScenarioError("not enough alive holders to kill")
         t0 = time.time()
+        self.facts.setdefault("fault_t", round(t0 - self.t0, 3))
         for victim in victims:
             self.plane.kill(victim.client_id)
             for i in range(defaults.AUDIT_DEMOTE_MISSES):
@@ -594,6 +648,7 @@ class ScenarioHarness:
         await app.start()
         app._audit_task.cancel()
         app._monitor_task.cancel()
+        app._slo_task.cancel()
         self.a = app
         self.monitor = app.monitor
         return app.engine.last_recovery
@@ -843,6 +898,43 @@ class ScenarioHarness:
                 if k.startswith("bkw_reclaim_bytes_freed_total"))
             out.append(A("gc_holders_freed_bytes", freed > 0,
                          f"reclaim_freed={freed:g}"))
+        if spec.slo:
+            breaches = facts.get("slo_breaches", [])
+            fault_t = facts.get("fault_t")
+            # detection: the first breach must land within 2 sweep
+            # intervals of the first violated invariant sample
+            first_bad = next((s["t"] for s in self.samples
+                              if s.get("status_level", 0) >= 2), None)
+            first_breach = breaches[0]["t"] if breaches else None
+            budget_s = 2 * defaults.DURABILITY_SWEEP_INTERVAL_S
+            detect_s = (None if first_breach is None or first_bad is None
+                        else round(first_breach - first_bad, 3))
+            out.append(A("slo_breach_detected",
+                         detect_s is not None and detect_s <= budget_s,
+                         f"detection={detect_s}s budget={budget_s}s"))
+            # precision: every breach must postdate the armed fault
+            false_pos = [b for b in breaches
+                         if fault_t is None or b["t"] < fault_t]
+            out.append(A("slo_no_false_positives", not false_pos,
+                         f"{len(false_pos)} breach(es) before the fault"))
+            # attribution: the armed fault site (a killed victim's id in
+            # a fault:* cause) must rank in the explainer's top-3
+            top3 = [c["id"] for d in self.diagnoses
+                    for c in d["causes"][:3]]
+            victims = facts.get("demoted", [])
+            named = any(c.startswith("fault:")
+                        and any(v in c for v in victims)
+                        for c in top3)
+            out.append(A("diagnosis_names_fault", named,
+                         f"top causes: {sorted(set(top3))[:6]}"))
+            facts["slo"] = {
+                "detection_s": detect_s,
+                "precision": (round(1.0 - len(false_pos)
+                                    / len(breaches), 4)
+                              if breaches else None),
+                "breaches": len(breaches),
+                "top_causes": top3[:3],
+            }
         return out
 
 
@@ -885,6 +977,18 @@ def builtin_scenarios() -> Dict[str, ScenarioSpec]:
         "loss": ScenarioSpec(
             name="loss", seed=41, expect_final_status="degraded",
             phases=(P("backup"), P("kill"), P("steady", duration_s=0.4))),
+        # the live-SLO acceptance run: a quiet pre-fault baseline, then
+        # three of six holders permanently dark — below RS k, so
+        # durability flips to violated, violation-seconds accrue, the
+        # fast burn windows fire, and the explainer must pin the armed
+        # kills (docs/observability.md §Diagnosis)
+        "diagnosis": ScenarioSpec(
+            name="diagnosis", seed=121, slo=True,
+            expect_violation=True, expect_final_status="violated",
+            phases=(P("backup"),
+                    P("steady", duration_s=1.0),
+                    P("kill", count=3),
+                    P("steady", duration_s=1.5))),
         "composed": ScenarioSpec(
             name="composed", seed=51, spares=2, min_shards_rebuilt=1,
             phases=(P("backup"),

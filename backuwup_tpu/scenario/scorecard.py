@@ -92,6 +92,11 @@ COUNTER_FAMILIES = (
     "bkw_gc_bytes_reclaimed_total",
     "bkw_reclaim_requests_total",
     "bkw_reclaim_bytes_freed_total",
+    # live SLO plane (PR 20): recorder sweeps, budget breaches, and the
+    # diagnosis reports the slo_* gates read
+    "bkw_series_samples_total",
+    "bkw_slo_breaches_total",
+    "bkw_diagnosis_reports_total",
 )
 
 #: Histogram families quantiled in the card.

@@ -35,12 +35,19 @@ import time
 from typing import Dict, Optional, Tuple
 
 from .. import defaults
+from ..obs import diagnose as obs_diagnose
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
+from ..obs.series import SeriesRecorder
 from .clock import SimClock
 from .driver import SimDriver
 from .model_client import SimParams, SimWorld
 
 WEEK_S = 7 * 86_400.0
+
+#: Virtual-time cadence of the SLO sampler — 2016 ticks per simulated
+#: week, far below the per-event budget.
+SLO_SAMPLE_S = 300.0
 
 _EVENTS = obs_metrics.counter(
     "bkw_sim_events_total", "Virtual-clock events fired per scenario run",
@@ -170,6 +177,20 @@ def _evaluate_gates(name: str, world: SimWorld, card: dict) -> list:
             1, int(world.params.clients * world.params.fail_fraction))
         _gate(gates, "violation_seconds_bounded", viol <= budget,
               f"{viol} client-seconds <= budget {budget}")
+        # the live SLO plane must notice the injected failure (never
+        # before it — pre-fault the world is provably quiet) and the
+        # explainer must pin the injection site in its top-3 causes
+        slo = card.get("slo") or {}
+        fail_at = world.params.fail_at_s or 0.0
+        first = slo.get("first_breach_t")
+        _gate(gates, "slo_breach_after_fault",
+              first is not None and first >= fail_at,
+              f"first breach at {first}s (fault at {fail_at:g}s)")
+        causes = [c["id"] for c in
+                  (slo.get("diagnosis") or {}).get("causes", [])[:3]]
+        _gate(gates, "slo_diagnosis_names_fault",
+              any(c.startswith("fault:sim.") for c in causes),
+              f"top causes: {causes}")
     elif name == "auditstorm":
         _gate(gates, "match_rate>=0.90", rate >= 0.90,
               f"placed/demand = {rate}")
@@ -234,6 +255,52 @@ async def run_scenario_async(name: str, spec: SimParams
     # the end instead.
     gc_was_enabled = gc.isenabled()
     gc.disable()
+
+    # --- live SLO plane on virtual time (obs/slo.py) ---------------------
+    # World-truth numbers are recorded as synthetic series (the registry
+    # is only flushed post-run), the burn-rate monitor runs the REAL
+    # multi-window spans against the virtual clock, and the first breach
+    # is diagnosed against the injected failure — all virtual-time
+    # derived, so card["slo"] replays byte-identically per seed.
+    recorder = SeriesRecorder((), clock=clock)
+    slo_catalog = [obs_slo.Objective(
+        id="sim_durability", kind="counter_rate",
+        family="sim:violation_fraction_seconds", budget=1e-4,
+        description="population fraction-seconds with unrestorable data")]
+    slo_state: dict = {"breaches": [], "diagnosis": None, "ticks": 0}
+
+    def _slo_breach(breach) -> None:
+        slo_state["breaches"].append(breach.to_dict())
+        if slo_state["diagnosis"] is None:
+            events = []
+            if spec.fail_at_s is not None and spec.fail_fraction > 0:
+                events.append({"ts": spec.fail_at_s, "kind": "fault",
+                               "site": f"sim.{spec.fail_kind}_fail"})
+            # window wide enough to reach back past the detection lag
+            # to the injection instant
+            slo_state["diagnosis"] = obs_diagnose.explain(
+                breach, recorder=recorder, events=events,
+                now=breach.t, window_s=4 * 3600.0)
+
+    slo = obs_slo.SLOMonitor(recorder, catalog=slo_catalog, clock=clock,
+                             on_breach=_slo_breach, client="sim")
+
+    def _slo_tick() -> None:
+        t = clock.monotonic()
+        world._accrue()  # bring the lazy ledger up to the tick instant
+        recorder.record("sim:violation_fraction_seconds",
+                        world.violation_client_seconds
+                        / max(spec.clients, 1), t=t, kind="counter")
+        recorder.record("sim:repair_debt_bytes",
+                        float(world.repair_debt_bytes), t=t)
+        recorder.record("sim:deaths", float(world.deaths), t=t,
+                        kind="counter")
+        slo_state["ticks"] += 1
+        slo.evaluate(now=t)
+        clock.call_later(SLO_SAMPLE_S, _slo_tick)
+
+    clock.call_later(SLO_SAMPLE_S, _slo_tick)
+
     t0 = _wall()
     try:
         world.populate()
@@ -272,6 +339,14 @@ async def run_scenario_async(name: str, spec: SimParams
             "violation_client_seconds":
                 round(world.violation_client_seconds, 3),
             "population": world.state_counts(),
+        }
+        card["slo"] = {
+            "ticks": slo_state["ticks"],
+            "status": slo.summary()["status"],
+            "breaches": slo_state["breaches"],
+            "first_breach_t": (slo_state["breaches"][0]["t"]
+                               if slo_state["breaches"] else None),
+            "diagnosis": slo_state["diagnosis"],
         }
         card["gates"] = _evaluate_gates(name, world, card)
         card["passed"] = all(g["passed"] for g in card["gates"])

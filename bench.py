@@ -331,6 +331,14 @@ def main() -> None:
         record["dataflow_overlap_efficiency"] = \
             dataflow["dataflow_overlap_efficiency"]
         record["dataflow_speedup"] = dataflow["dataflow_speedup"]
+    # config #21 is the live SLO plane: surface breach-detection latency
+    # and explainer precision at top level so BENCH_r*.json diffs (and
+    # scripts/bench_trend.py) track whether a durability incident still
+    # pages within the budget and the root-cause ranking stays exact
+    slo = configs.get("21_slo", {})
+    if "slo_detection_s" in slo:
+        record["slo_detection_s"] = slo["slo_detection_s"]
+        record["slo_precision"] = slo["slo_precision"]
     print(json.dumps({
         **record,
         "note": "corpus synthesized on-device (host<->device relay tunnel "

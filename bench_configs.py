@@ -1949,6 +1949,76 @@ def config20_dataflow(log: Callable) -> Dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def config21_slo(log: Callable) -> Dict:
+    """Live SLO plane: detection latency + explainer precision — #21.
+
+    Two legs land in one record (docs/observability.md §SLOs):
+
+    * **detection leg** — the ``diagnosis`` scenario (scenario/
+      harness.py): a quiet pre-fault baseline, then three of six
+      holders permanently dark — below RS k, so durability flips
+      violated and the shrunken fast burn windows must fire.  Hard
+      gates: the scenario's own scorecard all green, breach detection
+      within ``BENCH_C21_DETECTION_GATE`` seconds of the first violated
+      sample (default 1.0 — two patched sweep intervals), and explainer
+      precision 1.0 (zero pre-fault breaches, the armed fault site in
+      the top-3 causes).
+    * **determinism leg** — the ``regionfail`` sim at 2 000 clients /
+      3 virtual days twice with the same seed: the cards — burn ticks,
+      breach times, the ranked diagnosis — must be byte-identical
+      (``card_json``), so a paged operator can replay the exact
+      incident.
+    """
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    from backuwup_tpu.scenario import builtin_scenarios
+    from backuwup_tpu.scenario.harness import ScenarioHarness
+    from backuwup_tpu.sim import card_json, run_sim
+
+    detect_gate = float(os.environ.get("BENCH_C21_DETECTION_GATE", "1.0"))
+    spec = builtin_scenarios()["diagnosis"]
+
+    async def one_run(td: str):
+        harness = ScenarioHarness(spec, Path(td))
+        await harness.setup()
+        try:
+            card = await harness.run()
+        finally:
+            await harness.teardown()
+        return dict(harness.facts.get("slo") or {}), card
+
+    with tempfile.TemporaryDirectory(prefix="bkw_bench_slo_") as td:
+        slo, card = asyncio.run(one_run(td))
+
+    days3 = 3 * 86400.0
+    d1, _ = run_sim("regionfail", clients=2000, sim_seconds=days3)
+    d2, _ = run_sim("regionfail", clients=2000, sim_seconds=days3)
+    deterministic = card_json(d1) == card_json(d2)
+
+    detect_s = slo.get("detection_s")
+    precision = slo.get("precision")
+    passed = (card.passed and deterministic
+              and detect_s is not None and detect_s <= detect_gate
+              and precision == 1.0)
+    log(f"config#21 slo: diagnosis scenario "
+        f"{'green' if card.passed else 'RED'} detection={detect_s}s "
+        f"(gate {detect_gate}s) precision={precision} "
+        f"breaches={slo.get('breaches')} sim_determinism="
+        f"{'ok' if deterministic else 'BROKEN'} "
+        f"[{'PASS' if passed else 'FAIL'}]")
+    return {"passed": passed,
+            "slo_detection_s": detect_s,
+            "slo_precision": precision,
+            "slo_breaches": slo.get("breaches", 0),
+            "top_causes": slo.get("top_causes", []),
+            "deterministic": deterministic,
+            "sim_slo_status": (d1.get("slo") or {}).get("status"),
+            "wall_s": round(card.elapsed_s, 2),
+            "scorecard": card.to_dict()}
+
+
 def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             log: Callable) -> Dict:
     out = {}
@@ -1973,7 +2043,8 @@ def run_all(pipeline: DevicePipeline, params: CDCParams, cpu_mibs: float,
             ("17_tiered", lambda: config17_tiered(log)),
             ("18_replication", lambda: config18_replication(log)),
             ("19_sim", lambda: config19_sim(log)),
-            ("20_dataflow", lambda: config20_dataflow(log))):
+            ("20_dataflow", lambda: config20_dataflow(log)),
+            ("21_slo", lambda: config21_slo(log))):
         # BENCH_ONLY_CONFIG=<substring> re-runs a single config (the
         # tpu_watch.sh recapture path re-measures just "7_erasure")
         only = os.environ.get("BENCH_ONLY_CONFIG", "")

@@ -17,6 +17,17 @@ Three read-only views, no accelerator and no repo imports beyond stdlib:
 * ``--panic PATH`` — pretty-print a ``<journal>.panic.json`` flight-
   recorder dump (metrics snapshot + journal tail at panic time).
 
+Two SLO-plane views (PR 20):
+
+* ``--url ... --watch N --series`` — keep a rolling last-N history of
+  every changing sample across polls and render one unicode sparkline
+  per key (``~ series key ▁▃▇ last=...``) each interval: the terminal
+  version of the in-process ``obs/series.py`` ring buffers.
+* ``--journal PATH --explain`` — render the **latest** ranked
+  ``diagnosis_report`` journal line (obs/diagnose.py): the breach
+  header plus one ``score kind id xcount evidence`` line per cause.
+  Exits 1 when the journal holds no report.
+
 Plus one export: ``--journal PATH [--journal PATH2 ...] --timeline
 out.json`` merges the journals into one Chrome trace-event document
 loadable in Perfetto (ui.perfetto.dev), one process row per journal,
@@ -190,6 +201,37 @@ def _restore_lines(samples: dict) -> "list[str]":
     return lines
 
 
+_SPARK_BARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values) -> str:
+    """Min/max-normalized unicode sparkline; flat series render mid-bar
+    so one glance separates 'constant' from 'missing'."""
+    values = list(values)
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return _SPARK_BARS[3] * len(values)
+    idx = [int((v - lo) / (hi - lo) * (len(_SPARK_BARS) - 1))
+           for v in values]
+    return "".join(_SPARK_BARS[i] for i in idx)
+
+
+def _series_lines(history: dict, changed=None) -> "list[str]":
+    """One sparkline line per tracked key (``--series``); with
+    ``changed``, only keys whose last poll moved."""
+    lines = []
+    for key, values in sorted(history.items()):
+        if changed is not None and key not in changed:
+            continue
+        if len(values) < 2:
+            continue
+        lines.append(f"~ series {key} {_sparkline(values)} "
+                     f"last={values[-1]:g}")
+    return lines
+
+
 def _print_view(samples: dict, prev=None) -> None:
     """Non-zero samples (first poll) or changed-with-delta (re-polls),
     then the histogram quantile and per-peer estimator summary lines."""
@@ -222,7 +264,8 @@ def _merge(sample_maps) -> "dict[str, float]":
     return out
 
 
-def dump_metrics(urls, raw: bool, watch: float) -> int:
+def dump_metrics(urls, raw: bool, watch: float, series: bool = False,
+                 lastn: int = 50) -> int:
     """One URL: the classic view.  Several (repeated ``--url``, e.g. a
     federation's nodes): a per-node section each, then a merged view
     with counters summed — the fleet-wide picture one grep away."""
@@ -235,7 +278,17 @@ def dump_metrics(urls, raw: bool, watch: float) -> int:
         per = [_parse(_fetch(u)) for u in urls]
         return per, (_merge(per) if len(per) > 1 else per[0])
 
+    history: dict = {}
+
+    def track(samples):
+        if not series:
+            return
+        for key, value in samples.items():
+            history.setdefault(key, []).append(value)
+            del history[key][:-max(2, lastn)]
+
     per, merged = poll()
+    track(merged)
     if len(urls) > 1:
         for url, samples in zip(urls, per):
             print(f"== {url}")
@@ -245,8 +298,13 @@ def dump_metrics(urls, raw: bool, watch: float) -> int:
     while watch:
         time.sleep(watch)
         _, fresh = poll()
+        track(fresh)
         print(f"--- {time.strftime('%H:%M:%S')} (+{watch:g}s)")
         _print_view(fresh, prev=merged)
+        changed = {k for k, v in fresh.items()
+                   if v != merged.get(k, 0.0)}
+        for line in _series_lines(history, changed=changed):
+            print(line)
         merged = fresh
     return 0
 
@@ -268,6 +326,41 @@ def dump_journal(paths, lines: int, trace: str) -> int:
                 kept.append(doc)
     for doc in kept[-lines:]:
         print(json.dumps(doc, sort_keys=True))
+    return 0
+
+
+def dump_explain(paths) -> int:
+    """Render the newest ``diagnosis_report`` line across the journals:
+    breach header, then the evidence-ranked causes (obs/diagnose.py)."""
+    report = None
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    doc = json.loads(raw)
+                except ValueError:
+                    continue
+                if doc.get("kind") != "diagnosis_report":
+                    continue
+                if report is None or doc.get("ts", 0) >= report.get("ts", 0):
+                    report = doc
+    if report is None:
+        print("no diagnosis_report in journal(s)")
+        return 1
+    print(f"objective={report.get('objective', '?')} "
+          f"status={report.get('status', '?')} "
+          f"t={report.get('t', 0):g} "
+          f"window_s={report.get('window_s', 0):g} "
+          f"evidence_events={report.get('evidence_events', 0)}")
+    for cause in report.get("causes", ()):
+        evidence = cause.get("evidence", "")
+        print(f"  {cause.get('score', 0):6.3f} "
+              f"{cause.get('kind', '?'):<10} {cause.get('id', '?')} "
+              f"x{cause.get('count', 1)}"
+              + (f"  {evidence}" if evidence else ""))
     return 0
 
 
@@ -310,15 +403,25 @@ def main(argv=None) -> int:
     ap.add_argument("--watch", type=float, default=0.0, metavar="N",
                     help="with --url: re-poll every N seconds and print "
                          "changed samples with deltas (ctrl-c to stop)")
+    ap.add_argument("--series", action="store_true",
+                    help="with --url --watch: keep a rolling last-N"
+                         " (-n) history per sample and print sparklines"
+                         " for the keys that moved each interval")
+    ap.add_argument("--explain", action="store_true",
+                    help="with --journal: render the latest ranked"
+                         " diagnosis_report (exit 1 when none)")
     args = ap.parse_args(argv)
     if args.url:
         try:
-            return dump_metrics(args.url, args.raw, args.watch)
+            return dump_metrics(args.url, args.raw, args.watch,
+                                series=args.series, lastn=args.lines)
         except KeyboardInterrupt:
             return 0
     if args.journal:
         if args.timeline:
             return dump_timeline(args.journal, args.timeline, args.trace)
+        if args.explain:
+            return dump_explain(args.journal)
         return dump_journal(args.journal, args.lines, args.trace)
     return dump_panic(args.panic)
 

@@ -215,6 +215,29 @@ print('tpu ok', np.asarray(jnp.ones(8).sum()))" >/tmp/tpu_watch_probe.log 2>&1; 
         else
             echo "dataflow bench recapture FAILED (see $dfl) at $(date)" >> /tmp/tpu_watch.log
         fi
+        # dedicated slo recapture: config #21 alone (host-only live SLO
+        # plane: the diagnosis scenario's breach-detection latency and
+        # explainer precision, plus the sim burn-rate determinism
+        # double-run) — the detection/precision numbers survive even
+        # when the device suite timed out partway
+        slo="$BENCH_OUT_DIR/BENCH_slo_${stamp}.json"
+        if timeout "${BENCH_SLO_TIMEOUT_S:-600}" \
+                env BENCH_ONLY_CONFIG=21_slo BENCH_GIB=1 \
+                python "$REPO_DIR/bench.py" > "$slo" 2>>/tmp/tpu_watch.log; then
+            echo "slo bench recaptured to $slo at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "slo bench recapture FAILED (see $slo) at $(date)" >> /tmp/tpu_watch.log
+        fi
+        # trend check over the whole capture history (the one just
+        # written included): per-config deltas vs the previous capture,
+        # REGRESSION lines + nonzero exit when a gated metric slid —
+        # the watch log learns about a slide the moment it lands
+        if python "$REPO_DIR/scripts/bench_trend.py" \
+                --dir "$BENCH_OUT_DIR" >> /tmp/tpu_watch.log 2>&1; then
+            echo "bench trend clean at $(date)" >> /tmp/tpu_watch.log
+        else
+            echo "bench trend REGRESSION (see above) at $(date)" >> /tmp/tpu_watch.log
+        fi
         exit 0
     fi
     echo "still down $(date)" >> /tmp/tpu_watch.log

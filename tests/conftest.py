@@ -101,6 +101,10 @@ def pytest_configure(config):
         " (backuwup_tpu/sim, docs/simulation.md); the 10^5-client"
         " simulated-week builtin is tier-1, the 10^6 soak is also"
         " marked slow")
+    config.addinivalue_line(
+        "markers", "slo: live SLO-plane tests (obs/series.py burn-rate"
+        " windows, obs/slo.py multi-window gating, obs/diagnose.py"
+        " ranked explainer, docs/observability.md §SLOs); all tier-1")
 
 
 def pytest_collection_modifyitems(config, items):

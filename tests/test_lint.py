@@ -452,6 +452,82 @@ def test_bkw006_seam_calls_are_silent(tmp_path):
     assert _lint(root, {"BKW006"}).findings == []
 
 
+# --- BKW007: SLO-catalog sync -----------------------------------------------
+
+
+def _slo_pkg(tmp_path, catalog,
+             construct="C = metrics.counter('bkw_v_total', 'h',"
+                       " ('client',))\n"):
+    return _mk_pkg(tmp_path, {
+        "obs/metrics.py": _METRICS_STUB,
+        "a.py": "from .obs import metrics\n" + construct,
+        "defaults.py": f"SLO_CATALOG = {catalog!r}\n"})
+
+
+def _slo_doc(tmp_path, rows):
+    doc = tmp_path / "observability.md"
+    body = ["| Objective | Kind | Signal family | Budget |",
+            "|---|---|---|---|"] + rows
+    doc.write_text("\n".join(body) + "\n")
+    return doc
+
+
+_GOOD_ENTRY = {"id": "durability", "kind": "counter_rate",
+               "family": "bkw_v_total", "budget": 0.001}
+
+
+def test_bkw007_clean_catalog_and_doc(tmp_path):
+    root = _slo_pkg(tmp_path, (_GOOD_ENTRY,))
+    doc = _slo_doc(tmp_path, [
+        "| `durability` | counter_rate | `bkw_v_total` | 0.001 |"])
+    assert _lint(root, {"BKW007"}, doc_path=doc).findings == []
+
+
+def test_bkw007_unknown_family_and_label_drift(tmp_path):
+    ghost = dict(_GOOD_ENTRY, id="ghost", family="bkw_ghost_total")
+    drift = dict(_GOOD_ENTRY, id="drift", labels={"peer": "x"})
+    root = _slo_pkg(tmp_path, (_GOOD_ENTRY, ghost, drift))
+    doc = _slo_doc(tmp_path, [
+        "| `durability` | counter_rate | `bkw_v_total` | 0.001 |",
+        "| `ghost` | counter_rate | `bkw_ghost_total` | 0.001 |",
+        "| `drift` | counter_rate | `bkw_v_total` | 0.001 |"])
+    report = _lint(root, {"BKW007"}, doc_path=doc)
+    assert {f.anchor for f in report.findings} == {
+        "slo-unknown-family:ghost:family", "slo-label-drift:drift"}
+
+
+def test_bkw007_doc_sync_both_directions(tmp_path):
+    root = _slo_pkg(tmp_path, (_GOOD_ENTRY,))
+    # missing row -> undocumented; stale row -> uncatalogued; a row
+    # naming the wrong family -> doc-family-drift
+    doc = _slo_doc(tmp_path, [
+        "| `durability` | counter_rate | `bkw_other_total` | 0.001 |",
+        "| `retired` | counter_rate | `bkw_v_total` | 0.01 |"])
+    report = _lint(root, {"BKW007"}, doc_path=doc)
+    assert {f.anchor for f in report.findings} == {
+        "slo-doc-family-drift:durability", "slo-uncatalogued:retired"}
+    report = _lint(root, {"BKW007"}, doc_path=_slo_doc(tmp_path, []))
+    assert {f.anchor for f in report.findings} == {
+        "slo-undocumented:durability"}
+
+
+def test_bkw007_malformed_entries_and_unparsable_catalog(tmp_path):
+    bad_kind = dict(_GOOD_ENTRY, id="weird", kind="percentile")
+    no_total = {"id": "stalls", "kind": "ratio",
+                "family": "bkw_v_total", "budget": 0.02}
+    root = _slo_pkg(tmp_path, (bad_kind, no_total))
+    doc = _slo_doc(tmp_path, [])
+    report = _lint(root, {"BKW007"}, doc_path=doc)
+    assert {f.anchor for f in report.findings} == {
+        "slo-bad-entry:weird", "slo-bad-entry:stalls"}
+    root = _mk_pkg(tmp_path / "dyn", {
+        "obs/metrics.py": _METRICS_STUB,
+        "defaults.py": "SLO_CATALOG = tuple(build())\n"})
+    report = _lint(root, {"BKW007"}, doc_path=doc)
+    assert {f.anchor for f in report.findings} == {
+        "slo-unparsable-catalog"}
+
+
 # --- baseline semantics -----------------------------------------------------
 
 

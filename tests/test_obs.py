@@ -106,6 +106,23 @@ def test_prometheus_render_golden():
         'app_requests_total{path="/x"} 3\n')
 
 
+def test_prometheus_render_escaping_golden():
+    # exposition-format escaping pin: backslash FIRST, then newline and
+    # quote — a value like '\n' must render '\\n', never '\\\\n' or a
+    # literal line break that tears the sample line
+    reg = Registry()
+    reg.counter("app_weird_total", 'help with \\ and\nnewline',
+                ("path",)).inc(1, path='a\\b"c\nd')
+    assert reg.render_prometheus() == (
+        "# HELP app_weird_total help with \\\\ and\\nnewline\n"
+        "# TYPE app_weird_total counter\n"
+        'app_weird_total{path="a\\\\b\\"c\\nd"} 1\n')
+    # the escaped exposition must round-trip through a line-oriented
+    # parser: exactly 3 lines, the sample line intact
+    lines = reg.render_prometheus().splitlines()
+    assert len(lines) == 3 and lines[2].endswith("} 1")
+
+
 def test_family_conflicts():
     reg = Registry()
     c = reg.counter("t_total", "x", ("a",))
@@ -132,6 +149,37 @@ def test_journal_rotation_and_tail(tmp_path):
     # ordered across the rotation boundary, newest last
     assert [r["n"] for r in tail] == list(range(40, 60))
     assert all(r["kind"] == "tick" for r in tail)
+
+
+def test_journal_rotation_under_concurrent_writers(tmp_path):
+    # two writer threads race emit() across dozens of rotation
+    # boundaries: every line must parse (no torn writes) and every
+    # event must survive (no line lost to a mid-rotation rename)
+    j = Journal(tmp_path / "j.jsonl", max_bytes=2000, keep=20)
+    per_writer = 150
+    barrier = threading.Barrier(2)
+
+    def writer(tag):
+        barrier.wait()
+        for i in range(per_writer):
+            j.emit("tick", w=tag, n=i)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    j.close()
+    assert j.rotations > 2
+    seen = {"a": [], "b": []}
+    for path in j.files():
+        for line in path.read_text(encoding="utf-8").splitlines():
+            rec = json.loads(line)  # a torn line would raise here
+            seen[rec["w"]].append(rec["n"])
+    assert sorted(seen["a"]) == list(range(per_writer))
+    assert sorted(seen["b"]) == list(range(per_writer))
+    assert j.lines_written == 2 * per_writer
 
 
 def test_journal_panic_dump(tmp_path):

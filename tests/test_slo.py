@@ -1,0 +1,287 @@
+"""Live SLO plane: series window math on virtual time, multi-window
+burn-rate gating, the evidence-ranked breach explainer, and the
+``diagnosis`` scenario gate (docs/observability.md §Time series /
+§SLOs & burn rates / §Diagnosis)."""
+
+import asyncio
+import time
+from pathlib import Path
+
+import pytest
+
+from backuwup_tpu import defaults
+from backuwup_tpu.obs import diagnose as obs_diagnose
+from backuwup_tpu.obs import journal as obs_journal
+from backuwup_tpu.obs import metrics as obs_metrics
+from backuwup_tpu.obs import slo as obs_slo
+from backuwup_tpu.obs.series import SeriesRecorder, robust_zscore
+from backuwup_tpu.sim.clock import SimClock
+
+pytestmark = pytest.mark.slo
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """Zero the process registry and drop any installed journal so tests
+    never see each other's series."""
+    obs_metrics.registry().reset()
+    yield
+    obs_metrics.registry().reset()
+    obs_journal.uninstall()
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+# --- series recorder --------------------------------------------------------
+
+
+def test_series_delta_is_counter_reset_safe():
+    rec = SeriesRecorder(())
+    for t, v in ((0, 10.0), (1, 14.0), (2, 3.0), (3, 5.0)):
+        rec.record("bkw_c_total", v, t=t, kind="counter")
+    # 10->14 (+4), 14->3 (reset: accrue the post-reset floor 3),
+    # 3->5 (+2) — never a negative burn
+    assert rec.delta("bkw_c_total", 10.0) == 9.0
+    assert rec.rate("bkw_c_total", 10.0) == 3.0
+    assert rec.span("bkw_c_total", 10.0) == 3.0
+
+
+def test_series_retention_caps_per_family():
+    rec = SeriesRecorder({"k": 8})
+    for i in range(30):
+        rec.record("k", float(i), t=float(i))
+    pts = rec.points("k")
+    assert len(pts) == 8 and pts[-1] == (29.0, 29.0)
+    # unknown families fall back to the recorder-wide default
+    rec2 = SeriesRecorder((), retention=4)
+    for i in range(9):
+        rec2.record("other", float(i), t=float(i))
+    assert len(rec2.points("other")) == 4
+
+
+def test_series_window_is_anchored_on_last_point():
+    rec = SeriesRecorder(())
+    for t in (0.0, 5.0, 9.0, 10.0):
+        rec.record("g", t, t=t)
+    assert [p[0] for p in rec.points("g", 5.0)] == [5.0, 9.0, 10.0]
+
+
+def test_series_anomaly_flags_level_shift_not_flat():
+    rec = SeriesRecorder(())
+    for t in range(12):
+        rec.record("flat", 7.0, t=float(t))
+        rec.record("jump", 100.0 if t == 11 else 1.0, t=float(t))
+    flagged = rec.anomalies(window_s=60.0)
+    assert [a["key"] for a in flagged] == ["jump"]
+    assert flagged[0]["z"] >= defaults.SERIES_ANOMALY_Z
+    # MAD == 0 with a genuine outlier hits the cap, not a ZeroDivision
+    assert robust_zscore([1.0] * 8 + [50.0]) == 99.0
+
+
+def test_series_samples_registry_families_on_the_sim_clock():
+    clock = SimClock()
+    reg = obs_metrics.registry()
+    ctr = reg.counter("bkw_sl_events_total", "h", ("op",))
+    hist = reg.histogram("bkw_sl_lat_seconds", "h")
+    rec = SeriesRecorder(("bkw_sl_events_total", "bkw_sl_lat_seconds"),
+                         clock=clock)
+    for step in range(6):
+        ctr.inc(2, op="put")
+        hist.observe(0.002 if step < 5 else 30.0)
+        rec.sample()
+        clock.advance_to(float(step + 1) * 10.0)
+    keys = rec.family_keys("bkw_sl_events_total", {"op": "put"})
+    assert keys == ['bkw_sl_events_total{op=put}']
+    assert rec.delta(keys[0], 100.0) == 10.0  # 5 sampled steps of +2
+    hkey = rec.family_keys("bkw_sl_lat_seconds", {})[0]
+    frac = rec.fraction_over(hkey, 100.0, 1.0)
+    assert frac == pytest.approx(1 / 5)
+    assert rec.samples_taken == 6
+    fam = reg.get("bkw_series_samples_total")
+    assert sum(s["value"] for s in fam._snapshot_series()) == 6
+
+
+# --- burn-rate gating -------------------------------------------------------
+
+_WINDOWS = ((4.0, 12.0), (30.0, 60.0))
+
+
+def _monitor(rec, hook=None):
+    catalog = [obs_slo.Objective(
+        id="viol", kind="counter_rate", family="bkw_viol_total",
+        budget=0.05)]
+    return obs_slo.SLOMonitor(rec, catalog=catalog, windows=_WINDOWS,
+                              on_breach=hook)
+
+
+def test_slo_spike_does_not_page_sustained_burn_does():
+    rec = SeriesRecorder(())
+    breaches = []
+    mon = _monitor(rec, breaches.append)
+    cum = 0.0
+    for t in range(101):  # a quiet window of history
+        rec.record("bkw_viol_total", cum, t=float(t), kind="counter")
+    # 4 s spike: the fast-short window burns (frac 1.0 / 0.05 = 20x)
+    # but fast-long holds 4/12 => 6.7x < 14.4 — no page
+    for t in range(101, 105):
+        cum += 1.0
+        rec.record("bkw_viol_total", cum, t=float(t), kind="counter")
+    assert mon.evaluate(now=104.0) == {"viol": "ok"}
+    assert breaches == []
+    # sustained: fast-long reaches frac 1.0 as well — both fire
+    for t in range(105, 117):
+        cum += 1.0
+        rec.record("bkw_viol_total", cum, t=float(t), kind="counter")
+    assert mon.evaluate(now=116.0) == {"viol": "violated"}
+    assert len(breaches) == 1 and len(mon.breaches) == 1
+    b = breaches[0]
+    assert b.objective == "viol" and b.t == 116.0
+    assert b.prev_status == "ok" and b.status == "violated"
+    assert b.burns["4s"] >= mon.fast_burn <= b.burns["12s"]
+    # recovery: flat counter -> burn 0 -> ok again, no second breach
+    for t in range(117, 140):
+        rec.record("bkw_viol_total", cum, t=float(t), kind="counter")
+    assert mon.evaluate(now=139.0) == {"viol": "ok"}
+    assert len(mon.breaches) == 1
+    assert mon.summary()["status"] == "ok"
+
+
+def test_slo_no_signal_scores_burn_zero():
+    mon = _monitor(SeriesRecorder(()))
+    assert mon.evaluate(now=10.0) == {"viol": "ok"}
+    assert mon.last_burns["viol"] == {
+        "4s": 0.0, "12s": 0.0, "30s": 0.0, "60s": 0.0}
+
+
+def test_slo_status_exports_and_registry_summary():
+    rec = SeriesRecorder(())
+    mon = _monitor(rec)
+    cum = 0.0
+    for t in range(80):
+        cum += 1.0
+        rec.record("bkw_viol_total", cum, t=float(t), kind="counter")
+    assert mon.evaluate(now=79.0)["viol"] == "violated"
+    s = obs_slo.summary_from_registry()
+    assert s["status"] == "violated"
+    assert s["objectives"] == {"viol": "violated"} and s["breaches"] == 1
+    assert obs_slo.join_status("ok", "degraded", "ok") == "degraded"
+    assert obs_slo.join_status() == "ok"
+
+
+def test_slo_catalog_parses_and_rejects_malformed():
+    objectives = obs_slo.parse_catalog()
+    assert [o.id for o in objectives] == \
+        [e["id"] for e in defaults.SLO_CATALOG]
+    with pytest.raises(obs_slo.SLOError):
+        obs_slo.parse_catalog([{"id": "x", "kind": "nope",
+                                "family": "f", "budget": 0.1}])
+    with pytest.raises(obs_slo.SLOError):
+        obs_slo.parse_catalog([{"id": "x", "kind": "ratio",
+                                "family": "f", "budget": 0.1}])
+    with pytest.raises(obs_slo.SLOError):
+        obs_slo.parse_catalog(
+            [{"id": "x", "kind": "counter_rate", "family": "f",
+              "budget": 0.1}] * 2)
+
+
+# --- diagnosis --------------------------------------------------------------
+
+
+def _breach(t=1000.0):
+    return obs_slo.Breach(objective="viol", t=t, status="violated",
+                          prev_status="ok", burns={"4s": 20.0},
+                          window_s=12.0)
+
+
+def test_diagnose_ranks_fault_first_and_is_deterministic():
+    rec = SeriesRecorder(())
+    for t in range(988, 1000):
+        rec.record("noise", 100.0 if t == 999 else 1.0, t=float(t))
+    events = [
+        {"ts": 998.0, "kind": "fault", "site": "dial.dead:abcd1234"},
+        {"ts": 998.5, "kind": "fault", "site": "dial.dead:abcd1234"},
+        {"ts": 999.0, "kind": "durability", "status": "violated"},
+        {"ts": 997.0, "kind": "placement_demotion", "peer": "abcd1234"},
+        {"ts": 100.0, "kind": "fault", "site": "ancient.crash"},  # stale
+    ]
+    r1 = obs_diagnose.explain(_breach(), recorder=rec, events=events)
+    r2 = obs_diagnose.explain(_breach(), recorder=rec, events=events)
+    assert r1 == r2
+    ids = [c["id"] for c in r1["causes"]]
+    assert ids[0] == "fault:dial.dead:abcd1234"
+    assert r1["causes"][0]["count"] == 2
+    assert r1["causes"][0]["score"] > 4.0  # repeat bonus on top
+    assert "durability:violated" in ids
+    assert "event:placement_demotion" in ids
+    assert "series:noise" in ids  # anomaly evidence, weakest layer
+    assert ids.index("durability:violated") < ids.index("series:noise")
+    assert "fault:ancient.crash" not in ids  # outside the window
+    assert r1["objective"] == "viol" and r1["evidence_events"] == 4
+
+
+def test_diagnose_reads_installed_journal_and_counts_reports(tmp_path):
+    obs_journal.install(obs_journal.Journal(tmp_path / "j.jsonl"))
+    obs_journal.emit("fault", site="send.dead:feedbeef")
+    # breaches stamp clock.now() — the journal's epoch axis — so the
+    # explainer's window lines up with the emitted event's ts
+    breach = obs_slo.Breach(objective="viol", t=time.time(),
+                            status="violated", prev_status="ok",
+                            burns={}, window_s=0.0)
+    report = obs_diagnose.explain(breach)
+    assert [c["id"] for c in report["causes"]] == [
+        "fault:send.dead:feedbeef"]
+    # the report itself lands in the journal (skipped as evidence)
+    kinds = [r["kind"] for r in obs_journal.get().tail(10)]
+    assert "diagnosis_report" in kinds
+    fam = obs_metrics.registry().get("bkw_diagnosis_reports_total")
+    assert sum(s["value"] for s in fam._snapshot_series()) == 1
+
+
+def test_diagnose_truncates_to_top_and_caps_series_score():
+    rec = SeriesRecorder(())
+    events = [{"ts": 999.0, "kind": f"thing_{i}", "reason": "x"}
+              for i in range(12)]
+    report = obs_diagnose.explain(_breach(), recorder=rec,
+                                  events=events, top=3)
+    assert len(report["causes"]) == 3
+    assert all(c["score"] <= 4.0 for c in report["causes"])
+
+
+# --- the composed acceptance gate -------------------------------------------
+
+
+@pytest.mark.scenario
+def test_diagnosis_scenario_gate(tmp_path, loop):
+    """The PR-20 acceptance run: quiet baseline, three of six holders
+    permanently dark, durability flips violated — the breach must land
+    within two sweep intervals, with zero pre-fault breaches and the
+    armed fault site in the explainer's top-3 causes."""
+    from backuwup_tpu.scenario import builtin_scenarios
+    from backuwup_tpu.scenario.harness import ScenarioHarness
+
+    spec = builtin_scenarios()["diagnosis"]
+    harness = ScenarioHarness(spec, Path(tmp_path))
+
+    async def go():
+        await harness.setup()
+        try:
+            return await harness.run()
+        finally:
+            await harness.teardown()
+
+    card = loop.run_until_complete(go())
+    assert card.passed, card.render()
+    by_name = {a.name: a for a in card.assertions}
+    for gate in ("slo_breach_detected", "slo_no_false_positives",
+                 "diagnosis_names_fault"):
+        assert by_name[gate].passed, by_name[gate].detail
+    slo = harness.facts["slo"]
+    assert slo["precision"] == 1.0 and slo["breaches"] >= 1
+    assert slo["detection_s"] is not None
+    # two of the harness's patched 0.5 s sweep intervals
+    assert slo["detection_s"] <= 1.0
